@@ -1,0 +1,40 @@
+"""Distributed communication facade over XLA collectives.
+
+The reference's distributed backbone is ``comms_t``: a typed facade over a
+virtual transport (NCCL/UCX std_comms or MPI), injected into the resources
+handle, with rank/size, comm_split, barrier and the collective verbs
+(ref: cpp/include/raft/core/comms.hpp:125-232, comms/std_comms.hpp:26-187,
+SURVEY §2.11/§3.5).
+
+TPU-native re-expression: collectives are *compiler-inserted* — algorithms
+run inside ``shard_map`` over a ``jax.sharding.Mesh`` and call
+``psum``/``all_gather``/``ppermute``/... with an axis name; XLA lowers them
+onto ICI within a slice and DCN across slices. The ``Comms`` class here keeps
+the reference's verb surface so algorithm code written against comms_t
+translates verb-for-verb, while the transport bootstrap (NCCL uid exchange,
+Dask) collapses into ``jax.distributed.initialize`` + mesh construction.
+"""
+
+from raft_tpu.comms.comms import (
+    Comms,
+    make_mesh,
+    local_comms,
+    perform_test_comms_allreduce,
+    perform_test_comms_bcast,
+    perform_test_comms_allgather,
+    perform_test_comms_reduce,
+    perform_test_comms_reducescatter,
+    perform_test_comms_send_recv,
+)
+
+__all__ = [
+    "Comms",
+    "make_mesh",
+    "local_comms",
+    "perform_test_comms_allreduce",
+    "perform_test_comms_bcast",
+    "perform_test_comms_allgather",
+    "perform_test_comms_reduce",
+    "perform_test_comms_reducescatter",
+    "perform_test_comms_send_recv",
+]

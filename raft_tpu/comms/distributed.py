@@ -1,0 +1,108 @@
+"""Multi-device (SPMD) algorithms over the comms facade.
+
+The reference reaches multi-GPU through algorithms written against comms_t
+(data-parallel kmeans in cuML, distributed ANN; ref:
+docs/source/using_raft_comms.rst, SURVEY §2.13.4). Here the same two
+workhorses, written once against ``Comms`` and run under shard_map:
+
+- ``sharded_knn``: dataset rows sharded across the mesh axis; each shard
+  computes local top-k, then an all-gather + merge — the collective
+  equivalent of knn_merge_parts (ref: neighbors/detail/knn_merge_parts.cuh).
+  This is this domain's "ring attention": scaling dataset size beyond one
+  device (SURVEY §5 long-context note).
+- ``kmeans_step``: one Lloyd iteration with row-sharded data; centroid sums
+  and counts are psum-ed (allreduce) exactly like cuML's MNMG kmeans.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.distance.pairwise import DISTANCE_TYPES, distance_matrix_tile
+from raft_tpu.ops.matrix import select_k
+
+
+def sharded_knn(
+    comms: Comms,
+    dataset_sharded: jax.Array,
+    queries: jax.Array,
+    k: int,
+    *,
+    metric: str = "sqeuclidean",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN over a row-sharded dataset.
+
+    ``dataset_sharded`` is the global [n, d] array (sharded or shardable on
+    the comms axis); queries are replicated. Returns replicated
+    (distances [q, k], global indices [q, k]).
+    """
+    mesh = comms.mesh
+    axis = comms.axis
+    n = dataset_sharded.shape[0]
+    size = comms.get_size()
+    shard_rows = n // size
+    select_min = DISTANCE_TYPES[metric] != "inner_product"
+
+    def local(ds_shard, q):
+        rank = lax.axis_index(axis)
+        dist = distance_matrix_tile(q, ds_shard, metric)
+        v, i = select_k(dist, k, select_min=select_min)
+        gi = i + rank * shard_rows  # globalize ids
+        # gather all shards' candidates and reselect — merge step
+        vg = lax.all_gather(v, axis, axis=1, tiled=True)  # [q, size*k]
+        ig = lax.all_gather(gi, axis, axis=1, tiled=True)
+        return select_k(vg, k, select_min=select_min, input_indices=ig)
+
+    f = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    return f(dataset_sharded, queries)
+
+
+def kmeans_step(
+    comms: Comms,
+    data_sharded: jax.Array,
+    centroids: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """One distributed Lloyd iteration: assign + psum centroid sums/counts.
+
+    Returns (new_centroids [k, d] replicated, inertia scalar replicated).
+    The collective pattern of cuML MNMG kmeans over raft comms (allreduce of
+    per-worker centroid partial sums).
+    """
+    mesh = comms.mesh
+    axis = comms.axis
+    n_clusters = centroids.shape[0]
+
+    def local(x, c):
+        d2 = distance_matrix_tile(x, c, "sqeuclidean")
+        labels = jnp.argmin(d2, axis=1)
+        best = jnp.min(d2, axis=1)
+        sums = jax.ops.segment_sum(x, labels, num_segments=n_clusters)
+        counts = jax.ops.segment_sum(jnp.ones_like(best), labels, num_segments=n_clusters)
+        sums = lax.psum(sums, axis)
+        counts = lax.psum(counts, axis)
+        inertia = lax.psum(jnp.sum(best), axis)
+        newc = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c)
+        return newc, inertia
+
+    f = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(None, None), P()),
+        check_vma=False,
+    )
+    return f(data_sharded, centroids)

@@ -1,0 +1,23 @@
+"""Core runtime: resources/context, serialization, logging, bitset.
+
+TPU-native re-expression of the reference's core layer
+(ref: cpp/include/raft/core/ — resources.hpp, serialize.hpp, logger, bitset.hpp).
+"""
+
+from raft_tpu.core.resources import (
+    Resources,
+    DeviceResources,
+    default_resources,
+    set_default_resources,
+)
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core import serialize
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "default_resources",
+    "set_default_resources",
+    "Bitset",
+    "serialize",
+]

@@ -1,0 +1,104 @@
+"""Bitset over uint32 words — ANN pre-filtering support.
+
+TPU-native analog of ``raft::core::bitset`` (ref:
+cpp/include/raft/core/bitset.hpp:36-225): a device bitset with
+test/set/flip/count used as a query-time sample filter by the ANN indexes
+(ref: cpp/include/raft/neighbors/sample_filter_types.hpp:27-73
+``bitset_filter``). Functional: every mutator returns a new words array;
+the class is a thin pytree-friendly wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+def _n_words(n_bits: int) -> int:
+    return (n_bits + WORD_BITS - 1) // WORD_BITS
+
+
+@jax.tree_util.register_pytree_node_class
+class Bitset:
+    """Fixed-size bitset stored as packed uint32 words."""
+
+    def __init__(self, words: jax.Array, n_bits: int):
+        self.words = words
+        self.n_bits = n_bits
+
+    # pytree protocol so Bitset can cross jit boundaries
+    def tree_flatten(self):
+        return (self.words,), self.n_bits
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+    @classmethod
+    def create(cls, n_bits: int, default: bool = True) -> "Bitset":
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        return cls(jnp.full((_n_words(n_bits),), fill, dtype=jnp.uint32), n_bits)
+
+    @classmethod
+    def from_mask(cls, mask: jax.Array) -> "Bitset":
+        """Pack a boolean vector into a bitset."""
+        n_bits = mask.shape[0]
+        nw = _n_words(n_bits)
+        padded = jnp.zeros((nw * WORD_BITS,), dtype=jnp.uint32).at[:n_bits].set(
+            mask.astype(jnp.uint32)
+        )
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+        words = jnp.sum(padded.reshape(nw, WORD_BITS) << shifts[None, :], axis=1, dtype=jnp.uint32)
+        return cls(words, n_bits)
+
+    def test(self, idx: jax.Array) -> jax.Array:
+        """Elementwise membership test; idx any integer shape -> bool array."""
+        idx = jnp.asarray(idx)
+        word = self.words[idx // WORD_BITS]
+        return ((word >> (idx % WORD_BITS).astype(jnp.uint32)) & 1).astype(bool)
+
+    def set(self, idx: jax.Array, value: bool = True) -> "Bitset":
+        # Scatter through a boolean mask: duplicate indices in one call (or
+        # several indices landing in the same word) must all take effect, and
+        # .at[w].set on words is last-write-wins for duplicate words.
+        idx = jnp.atleast_1d(jnp.asarray(idx))
+        touched = Bitset.from_mask(
+            jnp.zeros((self.n_bits,), bool).at[idx].set(True)
+        ).words
+        if value:
+            words = self.words | touched
+        else:
+            words = self.words & ~touched
+        return Bitset(words, self.n_bits)
+
+    def flip(self) -> "Bitset":
+        return Bitset(~self.words, self.n_bits)
+
+    def count(self) -> jax.Array:
+        """Population count (ref: bitset.hpp count / util/popc.cuh)."""
+        # mask tail bits beyond n_bits
+        nw = self.words.shape[0]
+        tail_bits = self.n_bits - (nw - 1) * WORD_BITS
+        tail_mask = (
+            jnp.uint32(0xFFFFFFFF)
+            if tail_bits == WORD_BITS
+            else jnp.uint32((1 << tail_bits) - 1)
+        )
+        masked = self.words.at[-1].set(self.words[-1] & tail_mask)
+        x = masked
+        # SWAR popcount on uint32 lanes
+        x = x - ((x >> 1) & jnp.uint32(0x55555555))
+        x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+        x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+        per_word = (x * jnp.uint32(0x01010101)) >> 24
+        return jnp.sum(per_word.astype(jnp.int32))
+
+    def to_mask(self) -> jax.Array:
+        """Unpack into a boolean vector of length n_bits."""
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+        bits = (self.words[:, None] >> shifts[None, :]) & 1
+        return bits.reshape(-1)[: self.n_bits].astype(bool)

@@ -1,0 +1,175 @@
+"""Resources / context object — the TPU-native analog of ``raft::resources``.
+
+In the reference every public API takes ``raft::resources const&`` first; the
+container is a lazily-populated, factory-keyed registry carrying the CUDA
+stream, BLAS handles, workspace memory resource and communicator
+(ref: cpp/include/raft/core/resources.hpp:49-138,
+cpp/include/raft/core/resource/resource_types.hpp:29-50).
+
+On TPU the analogs are: the JAX device (PJRT), an optional
+``jax.sharding.Mesh`` for multi-chip execution, a deterministic PRNG key
+stream (replacing per-handle cuRAND state), a workspace byte budget used by
+tiled algorithms to pick tile sizes (replacing the RMM workspace resource),
+and a comms handle (``raft_tpu.comms``) for collectives.
+
+All raft_tpu public functions accept ``res: Resources | None`` as their first
+argument; ``None`` means the process-wide default resources, so interactive
+use stays ergonomic while services can inject isolated contexts
+(ref: cpp/include/raft/core/device_resources.hpp:63-239 — ``device_resources``
+is the same convenience pre-registration pattern).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class Resources:
+    """Lazily-populated, factory-keyed resource registry.
+
+    Mirrors ``raft::resources``'s add_resource_factory/get_resource contract
+    (ref: cpp/include/raft/core/resources.hpp:93-132): resources are created
+    on first access by a registered factory and cached. Shallow copies share
+    the registry, like the reference's copyable handle.
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: int = 0,
+        workspace_limit_bytes: int = 256 * 1024 * 1024,
+    ):
+        self._factories: Dict[str, Callable[["Resources"], Any]] = {}
+        self._resources: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._device = device
+        self._mesh = mesh
+        self._seed = seed
+        self._key_counter = 0
+        self.workspace_limit_bytes = workspace_limit_bytes
+
+    # -- registry (ref: core/resources.hpp add_resource_factory:93) --------
+    def add_resource_factory(self, key: str, factory: Callable[["Resources"], Any]) -> None:
+        with self._lock:
+            self._factories[key] = factory
+            self._resources.pop(key, None)
+
+    def has_resource_factory(self, key: str) -> bool:
+        return key in self._factories or key in self._resources
+
+    def get_resource(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._resources:
+                if key not in self._factories:
+                    raise KeyError(f"no resource or factory registered for {key!r}")
+                self._resources[key] = self._factories[key](self)
+            return self._resources[key]
+
+    def set_resource(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._resources[key] = value
+
+    # -- device / mesh -----------------------------------------------------
+    @property
+    def device(self) -> jax.Device:
+        if self._device is None:
+            self._device = jax.devices()[0]
+        return self._device
+
+    @property
+    def mesh(self) -> Optional[jax.sharding.Mesh]:
+        return self._mesh
+
+    def set_mesh(self, mesh: jax.sharding.Mesh) -> None:
+        self._mesh = mesh
+
+    # -- PRNG stream (replaces per-handle cuRAND generator state;
+    #    ref: cpp/include/raft/random/rng_state.hpp:29-52) ------------------
+    def prng_key(self) -> jax.Array:
+        """Return a fresh, deterministic PRNG key (threefry).
+
+        Keys form a counter-based stream seeded by the constructor seed, so a
+        Resources object reproduces the same sequence across runs — the
+        functional analog of the reference's stateful ``rng_state`` advancing
+        its subsequence counter.
+        """
+        with self._lock:
+            c = self._key_counter
+            self._key_counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), c)
+
+    def reseed(self, seed: int) -> None:
+        with self._lock:
+            self._seed = seed
+            self._key_counter = 0
+
+    # -- comms (ref: core/resource/comms.hpp — COMMUNICATOR resource) ------
+    @property
+    def comms(self):
+        return self.get_resource("comms")
+
+    def set_comms(self, comms) -> None:
+        """Inject a communicator (ref: comms/std_comms.hpp build_comms_* +
+        set_comms pattern, SURVEY §3.5)."""
+        self.set_resource("comms", comms)
+
+    # -- synchronization (ref: resource::sync_stream) -----------------------
+    def sync(self, *arrays) -> None:
+        """Block until given arrays (or all dispatched work) are ready.
+
+        The analog of ``resource::sync_stream`` — JAX dispatch is async like
+        CUDA streams; call this where the reference synchronizes.
+        """
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            # effectively a fence: tiny transfer round-trip on this device
+            jax.block_until_ready(jax.device_put(np.zeros(()), self.device))
+
+    # -- workspace sizing ---------------------------------------------------
+    def workspace_rows(self, row_bytes: int, cap: int = 1 << 16) -> int:
+        """How many rows of ``row_bytes`` fit in the workspace budget.
+
+        Tiled algorithms (brute-force kNN, pairwise distance) use this the
+        way the reference sizes batches against the RMM workspace resource
+        (ref: neighbors/detail/ivf_pq_search.cuh:549 get_max_batch_size).
+        """
+        n = max(1, self.workspace_limit_bytes // max(1, row_bytes))
+        return int(min(n, cap))
+
+
+# ``device_resources`` convenience alias (ref: core/device_resources.hpp:63).
+DeviceResources = Resources
+
+_default: Optional[Resources] = None
+_default_lock = threading.Lock()
+
+
+def default_resources() -> Resources:
+    """Process-wide default Resources (lazily created).
+
+    Analog of ``device_resources_manager``'s pooled per-device handles
+    (ref: cpp/include/raft/core/device_resources_manager.hpp:34-577), reduced
+    to the JAX model where one process drives all local devices.
+    """
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Resources()
+        return _default
+
+
+def set_default_resources(res: Resources) -> None:
+    global _default
+    with _default_lock:
+        _default = res
+
+
+def ensure(res: Optional[Resources]) -> Resources:
+    """Internal: resolve an optional resources argument."""
+    return res if res is not None else default_resources()

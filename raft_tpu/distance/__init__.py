@@ -1,0 +1,26 @@
+"""Pairwise distances, fused 1-NN, Gram kernels (ref: raft/distance/)."""
+
+from raft_tpu.distance.pairwise import (
+    DISTANCE_TYPES,
+    pairwise_distance,
+    distance_matrix_tile,
+)
+from raft_tpu.distance.fused_nn import (
+    fused_l2_nn_argmin,
+    fused_distance_nn_argmin,
+    fused_l2_nn,
+    masked_l2_nn_argmin,
+)
+from raft_tpu.distance.kernels import gram_matrix, KernelParams
+
+__all__ = [
+    "DISTANCE_TYPES",
+    "pairwise_distance",
+    "distance_matrix_tile",
+    "fused_l2_nn_argmin",
+    "fused_distance_nn_argmin",
+    "fused_l2_nn",
+    "masked_l2_nn_argmin",
+    "gram_matrix",
+    "KernelParams",
+]

@@ -1,0 +1,130 @@
+"""Fused pairwise-distance + arg-min (1-NN) — the k-means inner loop.
+
+Reference: ``fusedL2NN`` / ``fusedL2NNMinReduce`` compute, for each row of x,
+the nearest row of y without materializing the [m,n] distance matrix
+(ref: cpp/include/raft/distance/fused_l2_nn-inl.cuh:79-194,
+fused_distance_nn.cuh, detail/fused_distance_nn/).
+
+TPU design: the distance tile IS a matmul (expanded L2), so we compute
+row-tiles of the distance matrix on the MXU and immediately reduce them to
+(min, argmin) — XLA fuses the epilogue+reduction into the matmul consumer, so
+only [tile_m, n] ever exists in registers/VMEM. Functionally identical to the
+reference's fused kernel with the tile loop expressed as ``lax.map``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import distance_matrix_tile
+
+
+def _tile_rows_for(res: Resources, n: int, m: int) -> int:
+    return min(max(res.workspace_rows(4 * n), 8), max(m, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "sqrt", "tile_rows"))
+def _fused_nn_jit(x, y, metric: str, sqrt: bool, tile_rows: int):
+    m, d = x.shape
+    n_tiles = (m + tile_rows - 1) // tile_rows
+    pad = n_tiles * tile_rows - m
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    tiles = xp.reshape(n_tiles, tile_rows, d)
+
+    dist_metric = "sqeuclidean" if metric in ("euclidean", "l2", "sqeuclidean") else metric
+
+    def one_tile(t):
+        dist = distance_matrix_tile(t, y, dist_metric)
+        idx = jnp.argmin(dist, axis=1).astype(jnp.int32)
+        val = jnp.take_along_axis(dist, idx[:, None], axis=1)[:, 0]
+        return val, idx
+
+    vals, idxs = lax.map(one_tile, tiles)
+    vals = vals.reshape(-1)[:m]
+    idxs = idxs.reshape(-1)[:m]
+    if sqrt and dist_metric == "sqeuclidean":
+        vals = jnp.sqrt(vals)
+    return vals, idxs
+
+
+def fused_l2_nn(
+    x: jax.Array,
+    y: jax.Array,
+    *,
+    sqrt: bool = False,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(min_dist [m], argmin [m]) of L2 distance from each x row to y rows
+    (ref: fused_l2_nn-inl.cuh:79 fusedL2NN)."""
+    res = ensure(res)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    return _fused_nn_jit(x, y, "sqeuclidean", sqrt, _tile_rows_for(res, y.shape[0], x.shape[0]))
+
+
+def fused_l2_nn_argmin(
+    x: jax.Array, y: jax.Array, *, res: Optional[Resources] = None
+) -> jax.Array:
+    """Arg-min only (Python ref: pylibraft.distance.fused_l2_nn_argmin)."""
+    return fused_l2_nn(x, y, res=res)[1]
+
+
+def fused_distance_nn_argmin(
+    x: jax.Array,
+    y: jax.Array,
+    *,
+    metric: str = "sqeuclidean",
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Fused NN arg-min for L2 or cosine
+    (ref: distance/fused_distance_nn.cuh; Python ref:
+    pylibraft.distance.fused_distance_nn_argmin)."""
+    res = ensure(res)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if metric in ("euclidean", "l2", "sqeuclidean"):
+        return fused_l2_nn(x, y, res=res)[1]
+    if metric != "cosine":
+        raise ValueError("fused_distance_nn supports l2/sqeuclidean/cosine")
+    return _fused_nn_jit(x, y, "cosine", False, _tile_rows_for(res, y.shape[0], x.shape[0]))[1]
+
+
+def masked_l2_nn_argmin(
+    x: jax.Array,
+    y: jax.Array,
+    adj: jax.Array,
+    group_idxs: Optional[jax.Array] = None,
+    *,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked fused L2 NN (ref: distance/masked_nn.cuh): rows of x may only
+    match allowed columns of y.
+
+    ``adj`` is either a dense [m, n] boolean mask, or (with ``group_idxs``
+    [n_groups] end-offsets over y) the reference's [m, n_groups] bigraph
+    adjacency which we expand to the dense mask.
+    """
+    res = ensure(res)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    adj = jnp.asarray(adj)
+    n = y.shape[0]
+    if group_idxs is not None:
+        # column j belongs to group g iff prev_end <= j < end_g
+        ends = jnp.asarray(group_idxs)
+        starts = jnp.concatenate([jnp.zeros((1,), ends.dtype), ends[:-1]])
+        cols = jnp.arange(n)
+        group_of_col = jnp.sum(cols[None, :] >= ends[:, None], axis=0)  # [n]
+        adj = adj[:, group_of_col]
+
+    dist = distance_matrix_tile(x, y, "sqeuclidean")
+    dist = jnp.where(adj, dist, jnp.inf)
+    idx = jnp.argmin(dist, axis=1).astype(jnp.int32)
+    val = jnp.take_along_axis(dist, idx[:, None], axis=1)[:, 0]
+    return val, idx

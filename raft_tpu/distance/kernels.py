@@ -1,0 +1,46 @@
+"""Gram-matrix (SVM-style) kernels (ref: cpp/include/raft/distance/kernels.cuh,
+detail/kernels/ — linear / polynomial / tanh / RBF over dense inputs).
+
+All four are matmul + elementwise epilogue → pure MXU + fused VPU on TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.pairwise import distance_matrix_tile
+
+
+@dataclass
+class KernelParams:
+    """(ref: detail/kernels/kernel_matrices.cuh KernelParams)"""
+
+    kernel: str = "linear"  # linear | polynomial | tanh | rbf
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def gram_matrix(
+    x: jax.Array,
+    y: Optional[jax.Array] = None,
+    params: Optional[KernelParams] = None,
+) -> jax.Array:
+    params = params or KernelParams()
+    x = jnp.asarray(x, jnp.float32)
+    y = x if y is None else jnp.asarray(y, jnp.float32)
+    k = params.kernel
+    if k == "linear":
+        return x @ y.T
+    if k == "polynomial":
+        return (params.gamma * (x @ y.T) + params.coef0) ** params.degree
+    if k == "tanh":
+        return jnp.tanh(params.gamma * (x @ y.T) + params.coef0)
+    if k == "rbf":
+        d2 = distance_matrix_tile(x, y, "sqeuclidean")
+        return jnp.exp(-params.gamma * d2)
+    raise ValueError(f"unknown kernel {k!r}")

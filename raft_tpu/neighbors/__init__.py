@@ -1,0 +1,5 @@
+"""Nearest-neighbor indexes (ref: cpp/include/raft/neighbors/)."""
+
+from raft_tpu.neighbors import brute_force
+
+__all__ = ["brute_force"]

@@ -1,0 +1,207 @@
+"""Dense linear algebra primitives (ref: cpp/include/raft/linalg/).
+
+The reference wraps cuBLAS/cuSOLVER; here the MXU path is XLA's
+``dot_general`` (gemm) and ``jnp.linalg`` (solvers). The keyed reductions
+(``reduce_rows_by_key`` — the k-means centroid update) map to
+``jax.ops.segment_sum``, which XLA lowers to sorted-scatter on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---- BLAS level 3 (ref: linalg/gemm.cuh over cuBLAS/cuBLASLt) -------------
+
+
+def gemm(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    trans_a: bool = False,
+    trans_b: bool = False,
+    alpha: float = 1.0,
+    beta: float = 0.0,
+    c: Optional[jax.Array] = None,
+    precision=None,
+) -> jax.Array:
+    """alpha * op(A) @ op(B) + beta * C on the MXU."""
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = jnp.matmul(a, b, precision=precision)
+    if alpha != 1.0:
+        out = alpha * out
+    if beta != 0.0 and c is not None:
+        out = out + beta * c
+    return out
+
+
+def gemv(a: jax.Array, x: jax.Array, *, trans: bool = False) -> jax.Array:
+    return (a.T if trans else a) @ x
+
+
+def dot(x: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.vdot(x, y)
+
+
+def axpy(alpha: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    return alpha * x + y
+
+
+def transpose(m: jax.Array) -> jax.Array:
+    """(ref: linalg/transpose.cuh via cublas geam)"""
+    return m.T
+
+
+# ---- norms / normalization (ref: linalg/norm.cuh, normalize.cuh) ----------
+
+L1Norm, L2Norm, LinfNorm = "l1", "l2", "linf"
+
+
+def norm(m: jax.Array, *, norm_type: str = L2Norm, axis: int = 1, squared: bool = False) -> jax.Array:
+    if norm_type == L1Norm:
+        return jnp.sum(jnp.abs(m), axis=axis)
+    if norm_type == L2Norm:
+        sq = jnp.sum(m * m, axis=axis)
+        return sq if squared else jnp.sqrt(sq)
+    if norm_type == LinfNorm:
+        return jnp.max(jnp.abs(m), axis=axis)
+    raise ValueError(f"unknown norm {norm_type}")
+
+
+def row_normalize(m: jax.Array, *, norm_type: str = L2Norm, eps: float = 1e-12) -> jax.Array:
+    n = norm(m, norm_type=norm_type, axis=1)
+    return m / jnp.maximum(n, eps)[:, None]
+
+
+# ---- reductions (ref: linalg/reduce.cuh family) ---------------------------
+
+
+def reduce(m: jax.Array, *, axis: int = 1, op=jnp.sum) -> jax.Array:
+    return op(m, axis=axis)
+
+
+def map_then_reduce(map_op, m: jax.Array, *, axis: Optional[int] = None, reduce_op=jnp.sum) -> jax.Array:
+    """(ref: linalg/map_then_reduce.cuh) — XLA fuses this chain anyway."""
+    return reduce_op(map_op(m), axis=axis)
+
+
+def mean_squared_error(a: jax.Array, b: jax.Array) -> jax.Array:
+    d = a - b
+    return jnp.mean(d * d)
+
+
+def reduce_rows_by_key(
+    m: jax.Array,
+    keys: jax.Array,
+    n_keys: int,
+    *,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sum rows of ``m`` grouped by ``keys`` → [n_keys, n_cols].
+
+    The k-means centroid accumulation primitive
+    (ref: linalg/reduce_rows_by_key.cuh, used by
+    cluster/detail/kmeans_balanced.cuh centroid update). ``segment_sum``
+    lowers to a sorted scatter-add, the TPU-efficient equivalent of the
+    reference's atomics-based kernel.
+    """
+    if weights is not None:
+        m = m * weights[:, None]
+    return jax.ops.segment_sum(m, keys, num_segments=n_keys)
+
+
+def reduce_cols_by_key(m: jax.Array, keys: jax.Array, n_keys: int) -> jax.Array:
+    """(ref: linalg/reduce_cols_by_key.cuh)"""
+    return jax.ops.segment_sum(m.T, keys, num_segments=n_keys).T
+
+
+def binary_op(a: jax.Array, b: jax.Array, op) -> jax.Array:
+    return op(a, b)
+
+
+def unary_op(a: jax.Array, op) -> jax.Array:
+    return op(a)
+
+
+# ---- solvers (ref: linalg/{eig,qr,svd,rsvd,lstsq,cholesky_r1_update}.cuh) -
+
+
+def eig_dc(m: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition (ref: linalg/eig.cuh cusolver syevd).
+    Returns (eigenvalues ascending, eigenvectors as columns)."""
+    w, v = jnp.linalg.eigh(m)
+    return w, v
+
+
+def qr_q(m: jax.Array) -> jax.Array:
+    q, _ = jnp.linalg.qr(m)
+    return q
+
+
+def qr(m: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return jnp.linalg.qr(m)
+
+
+def svd(m: jax.Array, *, full_matrices: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    u, s, vt = jnp.linalg.svd(m, full_matrices=full_matrices)
+    return u, s, vt
+
+
+def rsvd(
+    key: jax.Array,
+    m: jax.Array,
+    rank: int,
+    *,
+    n_oversamples: int = 10,
+    n_iter: int = 4,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomized SVD (ref: linalg/rsvd.cuh): range finder with power
+    iterations + small exact SVD. MXU-dominated."""
+    n = m.shape[1]
+    p = min(rank + n_oversamples, n)
+    omega = jax.random.normal(key, (n, p), dtype=m.dtype)
+    y = m @ omega
+    q = qr_q(y)
+    for _ in range(n_iter):
+        q = qr_q(m.T @ q)
+        q = qr_q(m @ q)
+    b = q.T @ m
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return u[:, :rank], s[:rank], vt[:rank, :]
+
+
+def lstsq(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Least squares via QR (ref: linalg/lstsq.cuh)."""
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+def cholesky_r1_update(l: jax.Array, x: jax.Array) -> jax.Array:
+    """Rank-1 Cholesky update: chol(L L^T + x x^T)
+    (ref: linalg/cholesky_r1_update.cuh). Small-n host-style loop is fine —
+    used by incremental solvers, not hot paths; implemented with lax.scan
+    over columns for jit-ability."""
+    n = l.shape[0]
+
+    def body(carry, j):
+        l_, x_ = carry
+        ljj = l_[j, j]
+        xj = x_[j]
+        r = jnp.sqrt(ljj * ljj + xj * xj)
+        c = r / ljj
+        s = xj / ljj
+        col = l_[:, j]
+        mask = jnp.arange(n) > j
+        new_col = jnp.where(mask, (col + s * x_) / c, col)
+        new_col = new_col.at[j].set(r)
+        x_new = jnp.where(mask, c * x_ - s * new_col, x_)
+        return (l_.at[:, j].set(new_col), x_new), None
+
+    (l_out, _), _ = lax.scan(body, (l, x), jnp.arange(n))
+    return l_out

@@ -1,0 +1,44 @@
+"""RNG + synthetic data generators (ref: cpp/include/raft/random/).
+
+The reference carries stateful Philox/PCG generator state on the handle
+(ref: random/rng_state.hpp:29-52); JAX's threefry keys are the functional
+equivalent — ``Resources.prng_key()`` provides the per-handle stream.
+Distribution *parity* (not bitwise equality) is the test target, matching
+the reference's own test strategy (SURVEY §2.10).
+"""
+
+from raft_tpu.random.rng import (
+    RngState,
+    uniform,
+    uniform_int,
+    normal,
+    gumbel,
+    laplace,
+    lognormal,
+    exponential,
+    rayleigh,
+    bernoulli,
+    sample_without_replacement,
+    permute,
+    multi_variable_gaussian,
+)
+from raft_tpu.random.datagen import make_blobs, make_regression, rmat
+
+__all__ = [
+    "RngState",
+    "uniform",
+    "uniform_int",
+    "normal",
+    "gumbel",
+    "laplace",
+    "lognormal",
+    "exponential",
+    "rayleigh",
+    "bernoulli",
+    "sample_without_replacement",
+    "permute",
+    "multi_variable_gaussian",
+    "make_blobs",
+    "make_regression",
+    "rmat",
+]

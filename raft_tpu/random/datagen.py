@@ -1,0 +1,113 @@
+"""Synthetic dataset generators (ref: raft/random/{make_blobs,make_regression,
+rmat_rectangular_generator}.cuh). ``make_blobs`` is used pervasively by the
+reference's own tests (SURVEY §2.10) and ours.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def make_blobs(
+    key: jax.Array,
+    n_samples: int,
+    n_features: int,
+    *,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers: Optional[jax.Array] = None,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Clustered Gaussian blobs (ref: random/make_blobs.cuh).
+
+    Returns (data [n, d], labels [n], centers [k, d]).
+    """
+    kc, kl, kn, ks = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            kc, (n_clusters, n_features), dtype=dtype,
+            minval=center_box[0], maxval=center_box[1],
+        )
+    else:
+        centers = jnp.asarray(centers, dtype)
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(kl, (n_samples,), 0, n_clusters)
+    noise = cluster_std * jax.random.normal(kn, (n_samples, n_features), dtype=dtype)
+    data = centers[labels] + noise
+    if shuffle:
+        perm = jax.random.permutation(ks, n_samples)
+        data, labels = data[perm], labels[perm]
+    return data, labels.astype(jnp.int32), centers
+
+
+def make_regression(
+    key: jax.Array,
+    n_samples: int,
+    n_features: int,
+    *,
+    n_informative: int = 10,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model regression problem (ref: random/make_regression.cuh).
+
+    Returns (X [n, d], y [n, t], coef [d, t]).
+    """
+    n_informative = min(n_informative, n_features)
+    kx, kw, kn, ks = jax.random.split(key, 4)
+    x = jax.random.normal(kx, (n_samples, n_features), dtype=dtype)
+    coef = jnp.zeros((n_features, n_targets), dtype)
+    w = 100.0 * jax.random.uniform(kw, (n_informative, n_targets), dtype=dtype)
+    coef = coef.at[:n_informative].set(w)
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype=dtype)
+    if shuffle:
+        perm = jax.random.permutation(ks, n_samples)
+        x, y = x[perm], y[perm]
+    return x, y, coef
+
+
+def rmat(
+    key: jax.Array,
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+    *,
+    theta: Optional[jax.Array] = None,
+) -> jax.Array:
+    """R-MAT rectangular graph generator
+    (ref: random/rmat_rectangular_generator.cuh; Python ref:
+    pylibraft.random.rmat). Returns [n_edges, 2] (src, dst) int32.
+
+    Per-edge, each of max(r_scale, c_scale) levels picks a quadrant from the
+    (possibly per-level) theta distribution [a, b, c, d]; row bit is set for
+    quadrants c/d, col bit for b/d — vectorized across all edges at once.
+    """
+    max_scale = max(r_scale, c_scale)
+    if theta is None:
+        theta = jnp.tile(jnp.array([0.57, 0.19, 0.19, 0.05], jnp.float32), (max_scale, 1))
+    else:
+        theta = jnp.asarray(theta, jnp.float32).reshape(max_scale, 4)
+    theta = theta / jnp.sum(theta, axis=1, keepdims=True)
+
+    keys = jax.random.split(key, max_scale)
+    src = jnp.zeros((n_edges,), jnp.int32)
+    dst = jnp.zeros((n_edges,), jnp.int32)
+    for lvl in range(max_scale):
+        q = jax.random.categorical(keys[lvl], jnp.log(theta[lvl] + 1e-30), shape=(n_edges,))
+        row_bit = ((q >= 2) & (lvl < r_scale)).astype(jnp.int32)
+        col_bit = ((q % 2 == 1) & (lvl < c_scale)).astype(jnp.int32)
+        if lvl < r_scale:
+            src = (src << 1) | row_bit
+        if lvl < c_scale:
+            dst = (dst << 1) | col_bit
+    return jnp.stack([src, dst], axis=1)

@@ -1,0 +1,93 @@
+"""Distribution sampling API (ref: cpp/include/raft/random/rng.cuh).
+
+Each sampler takes an explicit key (threefry), mirroring the reference's
+RngState-first signatures (ref: random/rng.cuh uniform/normal/gumbel/...).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RngState:
+    """Seed + subsequence counter (ref: random/rng_state.hpp:29-52).
+
+    A thin stateful convenience over threefry keys for API parity; all
+    samplers below are pure and take keys directly.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._counter = 0
+
+    def next_key(self) -> jax.Array:
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._counter)
+        self._counter += 1
+        return key
+
+
+def uniform(key, shape, *, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype=dtype, minval=low, maxval=high)
+
+
+def uniform_int(key, shape, *, low=0, high=100, dtype=jnp.int32):
+    return jax.random.randint(key, shape, low, high, dtype=dtype)
+
+
+def normal(key, shape, *, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(key, shape, dtype=dtype)
+
+
+def gumbel(key, shape, *, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(key, shape, dtype=dtype)
+
+
+def laplace(key, shape, *, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(key, shape, dtype=dtype)
+
+
+def lognormal(key, shape, *, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(key, shape, mu=mu, sigma=sigma, dtype=dtype))
+
+
+def exponential(key, shape, *, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(key, shape, dtype=dtype) / lam
+
+
+def rayleigh(key, shape, *, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(key, shape, dtype=dtype, minval=1e-12, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def bernoulli(key, shape, *, prob=0.5, dtype=jnp.bool_):
+    return jax.random.bernoulli(key, prob, shape).astype(dtype)
+
+
+def sample_without_replacement(
+    key, population: int, n_samples: int, *, weights: Optional[jax.Array] = None
+) -> jax.Array:
+    """(ref: random/sample_without_replacement.cuh) — Gumbel-top-k trick when
+    weighted, direct choice otherwise."""
+    if weights is None:
+        return jax.random.choice(key, population, shape=(n_samples,), replace=False)
+    g = jax.random.gumbel(key, (population,)) + jnp.log(jnp.maximum(weights, 1e-30))
+    return jax.lax.top_k(g, n_samples)[1].astype(jnp.int32)
+
+
+def permute(key, n: int) -> jax.Array:
+    """Random permutation (ref: random/permute.cuh)."""
+    return jax.random.permutation(key, n)
+
+
+def multi_variable_gaussian(
+    key, mean: jax.Array, cov: jax.Array, n_samples: int
+) -> jax.Array:
+    """Sample N(mean, cov) (ref: random/multi_variable_gaussian.cuh, which
+    uses cuSOLVER factorization; here jnp.linalg.cholesky)."""
+    d = mean.shape[0]
+    chol = jnp.linalg.cholesky(cov + 1e-8 * jnp.eye(d, dtype=cov.dtype))
+    z = jax.random.normal(key, (n_samples, d), dtype=mean.dtype)
+    return mean[None, :] + z @ chol.T

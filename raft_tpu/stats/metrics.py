@@ -1,0 +1,190 @@
+"""Model metrics (ref: cpp/include/raft/stats/ — accuracy.cuh, r2_score.cuh,
+regression_metrics.cuh, neighborhood_recall.cuh, silhouette_score.cuh,
+adjusted_rand_index.cuh, rand_index.cuh, entropy.cuh, mutual_info_score.cuh,
+completeness_score.cuh, homogeneity_score.cuh, v_measure.cuh,
+contingency_matrix.cuh, kl_divergence.cuh, trustworthiness_score.cuh,
+information_criterion.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.pairwise import distance_matrix_tile
+
+
+def accuracy(pred: jax.Array, ref: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.asarray(pred) == jnp.asarray(ref)).astype(jnp.float32))
+
+
+def r2_score(y: jax.Array, y_hat: jax.Array) -> jax.Array:
+    y = jnp.asarray(y, jnp.float32)
+    y_hat = jnp.asarray(y_hat, jnp.float32)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(pred: jax.Array, ref: jax.Array) -> Dict[str, jax.Array]:
+    """(ref: stats/regression_metrics.cuh — mean abs / mean sq / median abs)"""
+    pred = jnp.asarray(pred, jnp.float32)
+    ref = jnp.asarray(ref, jnp.float32)
+    err = pred - ref
+    return {
+        "mean_abs_error": jnp.mean(jnp.abs(err)),
+        "mean_squared_error": jnp.mean(err * err),
+        "median_abs_error": jnp.median(jnp.abs(err)),
+    }
+
+
+def neighborhood_recall(indices: jax.Array, ref_indices: jax.Array) -> jax.Array:
+    """Fraction of reference neighbors recovered, per the reference's ANN
+    evaluation metric (ref: stats/neighborhood_recall.cuh;
+    cpp/test/neighbors/ann_utils.cuh:128 calc_recall — set-intersection per
+    row / (rows * k), order-insensitive)."""
+    indices = jnp.asarray(indices)
+    ref_indices = jnp.asarray(ref_indices)
+    match = (indices[:, :, None] == ref_indices[:, None, :]).any(axis=1)
+    return jnp.mean(match.astype(jnp.float32))
+
+
+def contingency_matrix(a: jax.Array, b: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    """(ref: stats/contingency_matrix.cuh)"""
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    if n_classes is None:
+        n_classes = int(max(int(jnp.max(a)), int(jnp.max(b))) + 1)
+    flat = a * n_classes + b
+    counts = jnp.zeros((n_classes * n_classes,), jnp.int32).at[flat].add(1)
+    return counts.reshape(n_classes, n_classes)
+
+
+def entropy(labels: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    labels = jnp.asarray(labels, jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(labels)) + 1
+    counts = jnp.zeros((n_classes,), jnp.float32).at[labels].add(1.0)
+    p = counts / labels.shape[0]
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)), 0.0))
+
+
+def mutual_info_score(a: jax.Array, b: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    cm = contingency_matrix(a, b, n_classes).astype(jnp.float32)
+    n = jnp.sum(cm)
+    pij = cm / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.maximum(pi * pj, 1e-30)
+    return jnp.sum(jnp.where(pij > 0, pij * jnp.log(jnp.maximum(ratio, 1e-30)), 0.0))
+
+
+def homogeneity_score(truth: jax.Array, pred: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    mi = mutual_info_score(truth, pred, n_classes)
+    h = entropy(truth, n_classes)
+    return jnp.where(h > 0, mi / jnp.maximum(h, 1e-30), 1.0)
+
+
+def completeness_score(truth: jax.Array, pred: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    return homogeneity_score(pred, truth, n_classes)
+
+
+def v_measure(truth: jax.Array, pred: jax.Array, n_classes: Optional[int] = None, beta: float = 1.0) -> jax.Array:
+    h = homogeneity_score(truth, pred, n_classes)
+    c = completeness_score(truth, pred, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / jnp.maximum(denom, 1e-30), 0.0)
+
+
+def rand_index(a: jax.Array, b: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    cm = contingency_matrix(a, b, n_classes).astype(jnp.float32)
+    n = jnp.sum(cm)
+    comb = lambda x: x * (x - 1) / 2
+    sum_ij = jnp.sum(comb(cm))
+    sum_i = jnp.sum(comb(jnp.sum(cm, axis=1)))
+    sum_j = jnp.sum(comb(jnp.sum(cm, axis=0)))
+    total = comb(n)
+    # RI = (agreements) / total pairs
+    return (total + 2 * sum_ij - sum_i - sum_j) / total
+
+
+def adjusted_rand_index(a: jax.Array, b: jax.Array, n_classes: Optional[int] = None) -> jax.Array:
+    cm = contingency_matrix(a, b, n_classes).astype(jnp.float32)
+    n = jnp.sum(cm)
+    comb = lambda x: x * (x - 1) / 2
+    sum_ij = jnp.sum(comb(cm))
+    sum_i = jnp.sum(comb(jnp.sum(cm, axis=1)))
+    sum_j = jnp.sum(comb(jnp.sum(cm, axis=0)))
+    expected = sum_i * sum_j / jnp.maximum(comb(n), 1e-30)
+    max_idx = 0.5 * (sum_i + sum_j)
+    return (sum_ij - expected) / jnp.maximum(max_idx - expected, 1e-30)
+
+
+def kl_divergence(p: jax.Array, q: jax.Array) -> jax.Array:
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30) / jnp.maximum(q, 1e-30)), 0.0))
+
+
+def silhouette_score(
+    x: jax.Array, labels: jax.Array, n_clusters: Optional[int] = None, *, metric: str = "euclidean"
+) -> jax.Array:
+    """Mean silhouette coefficient (ref: stats/silhouette_score.cuh)."""
+    x = jnp.asarray(x, jnp.float32)
+    labels = jnp.asarray(labels, jnp.int32)
+    if n_clusters is None:
+        n_clusters = int(jnp.max(labels)) + 1
+    n = x.shape[0]
+    d = distance_matrix_tile(x, x, metric)
+    onehot = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)  # [n, k]
+    counts = jnp.sum(onehot, axis=0)  # [k]
+    # per-point sum of distances to each cluster: [n, k]
+    sums = d @ onehot
+    same = jnp.take_along_axis(sums, labels[:, None], axis=1)[:, 0]
+    own_count = counts[labels]
+    a = jnp.where(own_count > 1, same / jnp.maximum(own_count - 1, 1), 0.0)
+    mean_other = sums / jnp.maximum(counts[None, :], 1)
+    # mask own cluster AND empty clusters (whose mean would read as 0)
+    mean_other = jnp.where(
+        jax.nn.one_hot(labels, n_clusters, dtype=bool) | (counts[None, :] == 0),
+        jnp.inf,
+        mean_other,
+    )
+    b = jnp.min(mean_other, axis=1)
+    s = jnp.where(own_count > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return jnp.mean(s)
+
+
+def trustworthiness(
+    x: jax.Array, x_embedded: jax.Array, n_neighbors: int, *, metric: str = "euclidean"
+) -> jax.Array:
+    """Trustworthiness of an embedding (ref: stats/trustworthiness_score.cuh)."""
+    x = jnp.asarray(x, jnp.float32)
+    e = jnp.asarray(x_embedded, jnp.float32)
+    n = x.shape[0]
+    d_orig = distance_matrix_tile(x, x, metric).at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    d_emb = distance_matrix_tile(e, e, metric).at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    rank_orig = jnp.argsort(jnp.argsort(d_orig, axis=1), axis=1)  # 0 = nearest
+    nn_emb = jnp.argsort(d_emb, axis=1)[:, :n_neighbors]
+    r = jnp.take_along_axis(rank_orig, nn_emb, axis=1)  # ranks in original space
+    penalty = jnp.sum(jnp.maximum(r - n_neighbors + 1, 0))
+    norm = 2.0 / (n * n_neighbors * (2 * n - 3 * n_neighbors - 1))
+    return 1.0 - norm * penalty
+
+
+def information_criterion(
+    log_likelihood: jax.Array, n_params: int, n_samples: int, *, criterion: str = "aic"
+) -> jax.Array:
+    """(ref: stats/information_criterion.cuh — AIC/AICc/BIC)"""
+    ll = jnp.asarray(log_likelihood, jnp.float32)
+    if criterion == "aic":
+        return -2.0 * ll + 2.0 * n_params
+    if criterion == "aicc":
+        return -2.0 * ll + 2.0 * n_params + (2.0 * n_params * (n_params + 1)) / max(
+            n_samples - n_params - 1, 1
+        )
+    if criterion == "bic":
+        return -2.0 * ll + n_params * jnp.log(float(n_samples))
+    raise ValueError(criterion)

@@ -1,0 +1,70 @@
+"""Summary statistics (ref: raft/stats/{mean,meanvar,stddev,minmax,cov,
+histogram,weighted_mean,mean_center,dispersion}.cuh). All are plain XLA
+reductions — the reference's custom kernels exist only because CUDA needs
+hand-written reductions; TPU gets them from the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mean(m: jax.Array, *, axis: int = 0) -> jax.Array:
+    return jnp.mean(m, axis=axis)
+
+
+def mean_center(m: jax.Array, *, axis: int = 0) -> jax.Array:
+    return m - jnp.mean(m, axis=axis, keepdims=True)
+
+
+def meanvar(m: jax.Array, *, axis: int = 0, sample: bool = True) -> Tuple[jax.Array, jax.Array]:
+    mu = jnp.mean(m, axis=axis)
+    var = jnp.var(m, axis=axis, ddof=1 if sample else 0)
+    return mu, var
+
+
+def stddev(m: jax.Array, *, axis: int = 0, sample: bool = True) -> jax.Array:
+    return jnp.std(m, axis=axis, ddof=1 if sample else 0)
+
+
+def minmax(m: jax.Array, *, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    return jnp.min(m, axis=axis), jnp.max(m, axis=axis)
+
+
+def cov(m: jax.Array, *, sample: bool = True, centered: bool = False) -> jax.Array:
+    """Column covariance matrix (ref: stats/cov.cuh) — one MXU gemm."""
+    x = m if centered else mean_center(m, axis=0)
+    n = m.shape[0]
+    denom = (n - 1) if sample else n
+    return (x.T @ x) / denom
+
+
+def histogram(m: jax.Array, n_bins: int, *, lo: float, hi: float) -> jax.Array:
+    """Per-column histogram (ref: stats/histogram.cuh)."""
+    m2 = m if m.ndim == 2 else m[:, None]
+    scaled = (m2 - lo) / (hi - lo) * n_bins
+    bins = jnp.clip(scaled.astype(jnp.int32), 0, n_bins - 1)
+    out = jax.vmap(
+        lambda col: jnp.zeros((n_bins,), jnp.int32).at[col].add(1), in_axes=1, out_axes=1
+    )(bins)
+    return out
+
+
+def weighted_mean(m: jax.Array, weights: jax.Array, *, axis: int = 0) -> jax.Array:
+    if axis == 0:
+        return jnp.sum(m * weights[:, None], axis=0) / jnp.sum(weights)
+    return jnp.sum(m * weights[None, :], axis=1) / jnp.sum(weights)
+
+
+def dispersion(
+    centroids: jax.Array, cluster_sizes: jax.Array, *, global_centroid: Optional[jax.Array] = None
+) -> jax.Array:
+    """Between-cluster dispersion (ref: stats/dispersion.cuh)."""
+    n = jnp.sum(cluster_sizes)
+    if global_centroid is None:
+        global_centroid = jnp.sum(centroids * cluster_sizes[:, None], axis=0) / n
+    d2 = jnp.sum((centroids - global_centroid[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(cluster_sizes * d2) / n)

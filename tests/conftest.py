@@ -1,0 +1,30 @@
+"""Test configuration: run on CPU with 8 virtual devices.
+
+Mirrors the reference's strategy of simulating multi-node with
+multi-process-per-box (SURVEY §4, raft-dask LocalCUDACluster tests): here a
+single process gets 8 XLA host devices so mesh/sharding/collective logic is
+exercised without TPU hardware.
+
+Note: this image pre-imports jax at interpreter startup with the axon TPU
+platform selected, so env vars are too late — we switch platforms through
+jax.config, which works because no backend has been initialized yet.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)

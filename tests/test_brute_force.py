@@ -1,0 +1,75 @@
+"""Brute-force kNN: recall == 1.0 vs exact numpy groundtruth
+(BASELINE config #2 semantics; ref test strategy: cpp/test/neighbors/
+ann_brute_force + pylibraft/test/test_brute_force)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as scipy_dist
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.neighbors import brute_force
+from raft_tpu.stats import neighborhood_recall
+
+
+def numpy_knn(x, q, k, metric="sqeuclidean", largest=False):
+    d = scipy_dist.cdist(q.astype(np.float64), x.astype(np.float64), metric)
+    if largest:
+        idx = np.argsort(-d, axis=1)[:, :k]
+    else:
+        idx = np.argsort(d, axis=1)[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine", "cityblock"])
+def test_knn_exact(rng, metric):
+    x = rng.random((500, 32)).astype(np.float32)
+    q = rng.random((40, 32)).astype(np.float32)
+    vals, idx = brute_force.knn(x, q, 10, metric=metric)
+    want_d, want_i = numpy_knn(x, q, 10, metric)
+    # distances match exactly; indices compared as sets (float32 tie order
+    # may differ from the float64 reference — same policy as the reference's
+    # recall-based ANN checks, cpp/test/neighbors/ann_utils.cuh:128)
+    np.testing.assert_allclose(np.asarray(vals), want_d, rtol=2e-3, atol=2e-3)
+    assert float(neighborhood_recall(np.asarray(idx), want_i)) >= 0.999
+
+
+def test_knn_inner_product(rng):
+    x = rng.random((300, 16)).astype(np.float32)
+    q = rng.random((20, 16)).astype(np.float32)
+    vals, idx = brute_force.knn(x, q, 5, metric="inner_product")
+    sim = q @ x.T
+    want_i = np.argsort(-sim, axis=1)[:, :5]
+    assert float(neighborhood_recall(np.asarray(idx), want_i)) >= 0.999
+
+
+def test_knn_tiled_small_workspace(rng):
+    """Dataset tiling across scan steps must be exact."""
+    res = Resources(workspace_limit_bytes=64 * 1024)
+    x = rng.random((3000, 24)).astype(np.float32)
+    q = rng.random((33, 24)).astype(np.float32)
+    vals, idx = brute_force.knn(x, q, 15, res=res)
+    _, want_i = numpy_knn(x, q, 15)
+    assert float(neighborhood_recall(np.asarray(idx), want_i)) >= 0.999
+
+
+def test_index_build_search_save_load(rng, tmp_path):
+    x = rng.random((200, 8)).astype(np.float32)
+    q = rng.random((10, 8)).astype(np.float32)
+    index = brute_force.build(x, metric="euclidean")
+    v1, i1 = brute_force.search(index, q, 4)
+    fn = str(tmp_path / "bf.idx")
+    brute_force.save(fn, index)
+    index2 = brute_force.load(fn)
+    v2, i2 = brute_force.search(index2, q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+
+def test_recall_metric(rng):
+    """neighborhood_recall parity check (ref: stats/neighborhood_recall.cuh)."""
+    x = rng.random((500, 16)).astype(np.float32)
+    q = rng.random((50, 16)).astype(np.float32)
+    _, idx = brute_force.knn(x, q, 10)
+    _, gt = numpy_knn(x, q, 10)
+    r = float(neighborhood_recall(np.asarray(idx), gt))
+    assert r == pytest.approx(1.0)

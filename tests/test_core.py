@@ -1,0 +1,121 @@
+"""Core: resources, bitset, serialization (mirrors cpp/test/core/)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import Bitset, Resources, serialize
+
+
+class TestResources:
+    def test_lazy_factory(self):
+        res = Resources()
+        calls = []
+        res.add_resource_factory("thing", lambda r: calls.append(1) or "made")
+        assert res.get_resource("thing") == "made"
+        assert res.get_resource("thing") == "made"
+        assert len(calls) == 1  # factory ran once
+
+    def test_missing_resource_raises(self):
+        with pytest.raises(KeyError):
+            Resources().get_resource("nope")
+
+    def test_prng_stream_deterministic(self):
+        a = Resources(seed=7)
+        b = Resources(seed=7)
+        ka = [np.asarray(a.prng_key()) for _ in range(3)]
+        kb = [np.asarray(b.prng_key()) for _ in range(3)]
+        np.testing.assert_array_equal(np.stack(ka), np.stack(kb))
+        assert not np.array_equal(ka[0], ka[1])
+
+    def test_workspace_rows(self):
+        res = Resources(workspace_limit_bytes=1024)
+        assert res.workspace_rows(128) == 8
+
+
+class TestBitset:
+    def test_create_set_test(self):
+        bs = Bitset.create(100, default=False)
+        bs = bs.set(jnp.array([0, 5, 99]))
+        assert bool(bs.test(0)) and bool(bs.test(5)) and bool(bs.test(99))
+        assert not bool(bs.test(1))
+        assert int(bs.count()) == 3
+
+    def test_set_same_word_multiple_bits(self):
+        """Regression: several indices in one 32-bit word in a single call."""
+        bs = Bitset.create(8, default=False).set(jnp.array([0, 1, 2]))
+        mask = np.asarray(bs.to_mask())
+        np.testing.assert_array_equal(mask[:4], [True, True, True, False])
+        assert int(bs.count()) == 3
+
+    def test_clear_bits(self):
+        bs = Bitset.create(64, default=True).set(jnp.array([3, 40]), value=False)
+        assert not bool(bs.test(3)) and not bool(bs.test(40))
+        assert int(bs.count()) == 62
+
+    def test_count_respects_tail(self):
+        bs = Bitset.create(33, default=True)
+        assert int(bs.count()) == 33
+
+    def test_from_mask_roundtrip(self, rng):
+        mask = rng.random(77) > 0.5
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(bs.to_mask()), mask)
+        assert int(bs.count()) == mask.sum()
+
+    def test_flip(self):
+        bs = Bitset.create(10, default=False).set(jnp.array([1]))
+        flipped = bs.flip()
+        assert not bool(flipped.test(1)) and bool(flipped.test(0))
+
+    def test_jit_boundary(self):
+        bs = Bitset.from_mask(jnp.array([True, False, True]))
+
+        @jax.jit
+        def f(b):
+            return b.test(jnp.array([0, 1, 2]))
+
+        np.testing.assert_array_equal(np.asarray(f(bs)), [True, False, True])
+
+
+class TestSerialize:
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        for v in [True, 42, 3.5, "hello"]:
+            serialize.serialize_scalar(buf, v)
+        buf.seek(0)
+        assert serialize.deserialize_scalar(buf) is True
+        assert serialize.deserialize_scalar(buf) == 42
+        assert serialize.deserialize_scalar(buf) == 3.5
+        assert serialize.deserialize_scalar(buf) == "hello"
+
+    def test_array_is_npy_format(self, rng):
+        buf = io.BytesIO()
+        arr = rng.random((3, 4)).astype(np.float32)
+        serialize.serialize_array(buf, arr)
+        buf.seek(0)
+        loaded = np.load(buf)  # plain numpy can read it
+        np.testing.assert_array_equal(loaded, arr)
+
+    def test_tree_roundtrip(self, rng, tmp_path):
+        fn = str(tmp_path / "t.bin")
+        arrays = {"a": rng.random((2, 2)).astype(np.float32)}
+        serialize.save_tree(fn, "test_kind", 3, {"n": 5, "name": "x"}, arrays)
+        scalars, loaded = serialize.load_tree(fn, "test_kind", 3)
+        assert scalars == {"n": 5, "name": "x"}
+        np.testing.assert_array_equal(loaded["a"], arrays["a"])
+
+    def test_version_mismatch(self, tmp_path):
+        fn = str(tmp_path / "t.bin")
+        serialize.save_tree(fn, "k", 1, {}, {})
+        with pytest.raises(ValueError, match="version"):
+            serialize.load_tree(fn, "k", 2)
+
+    def test_kind_mismatch(self, tmp_path):
+        fn = str(tmp_path / "t.bin")
+        serialize.save_tree(fn, "ivf_flat", 1, {}, {})
+        with pytest.raises(ValueError, match="expected"):
+            serialize.load_tree(fn, "ivf_pq", 1)

@@ -1,0 +1,117 @@
+"""Pairwise distance vs scipy/numpy references.
+
+Mirrors the reference's Python test strategy: compare against
+scipy.spatial.distance.cdist (ref: pylibraft/test/test_distance.py).
+"""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as scipy_dist
+
+from raft_tpu.distance import pairwise_distance
+
+SCIPY_METRICS = [
+    ("euclidean", "euclidean"),
+    ("sqeuclidean", "sqeuclidean"),
+    ("cityblock", "cityblock"),
+    ("chebyshev", "chebyshev"),
+    ("canberra", "canberra"),
+    ("cosine", "cosine"),
+    ("correlation", "correlation"),
+    ("braycurtis", "braycurtis"),
+    ("jensenshannon", "jensenshannon"),
+    ("hamming", "hamming"),
+]
+
+
+@pytest.mark.parametrize("ours,scipys", SCIPY_METRICS)
+@pytest.mark.parametrize("shape", [(40, 16), (33, 7)])
+def test_vs_scipy(rng, ours, scipys, shape):
+    m, d = shape
+    x = rng.random((m, d)).astype(np.float32)
+    y = rng.random((25, d)).astype(np.float32)
+    if ours == "jensenshannon":
+        x /= x.sum(axis=1, keepdims=True)
+        y /= y.sum(axis=1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric=ours))
+    want = scipy_dist.cdist(x.astype(np.float64), y.astype(np.float64), scipys)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_minkowski(rng):
+    x = rng.random((20, 8)).astype(np.float32)
+    y = rng.random((15, 8)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="minkowski", p=3.0))
+    want = scipy_dist.cdist(x, y, "minkowski", p=3.0)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_inner_product(rng):
+    x = rng.random((20, 8)).astype(np.float32)
+    y = rng.random((15, 8)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="inner_product"))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-4, atol=1e-4)
+
+
+def test_hellinger(rng):
+    x = rng.random((20, 8)).astype(np.float32)
+    y = rng.random((15, 8)).astype(np.float32)
+    x /= x.sum(axis=1, keepdims=True)
+    y /= y.sum(axis=1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="hellinger"))
+    ip = np.sqrt(x) @ np.sqrt(y).T
+    want = np.sqrt(np.maximum(1 - ip, 0))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_kl_divergence(rng):
+    x = rng.random((10, 8)).astype(np.float32) + 0.1
+    y = rng.random((9, 8)).astype(np.float32) + 0.1
+    x /= x.sum(axis=1, keepdims=True)
+    y /= y.sum(axis=1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="kl_divergence"))
+    want = np.array([[np.sum(xi * np.log(xi / yj)) for yj in y] for xi in x])
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("metric", ["jaccard", "dice", "russellrao"])
+def test_boolean_metrics(rng, metric):
+    x = (rng.random((20, 32)) > 0.5).astype(np.float32)
+    y = (rng.random((15, 32)) > 0.5).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric=metric))
+    want = scipy_dist.cdist(x.astype(bool), y.astype(bool), metric)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_haversine(rng):
+    x = (rng.random((10, 2)) - 0.5).astype(np.float32) * np.array([np.pi, 2 * np.pi], np.float32)
+    y = (rng.random((8, 2)) - 0.5).astype(np.float32) * np.array([np.pi, 2 * np.pi], np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="haversine"))
+
+    def hav(a, b):
+        dlat = b[0] - a[0]
+        dlon = b[1] - a[1]
+        h = np.sin(dlat / 2) ** 2 + np.cos(a[0]) * np.cos(b[0]) * np.sin(dlon / 2) ** 2
+        return 2 * np.arcsin(np.sqrt(h))
+
+    want = np.array([[hav(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_self_distance_default_y(rng):
+    x = rng.random((12, 5)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, metric="euclidean"))
+    want = scipy_dist.cdist(x, x, "euclidean")
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_tiny_workspace_tiling(rng):
+    """Row-tiling must not change results."""
+    from raft_tpu.core.resources import Resources
+
+    res = Resources(workspace_limit_bytes=4096)
+    x = rng.random((37, 16)).astype(np.float32)
+    y = rng.random((23, 16)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="canberra", res=res))
+    want = scipy_dist.cdist(x, y, "canberra")
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

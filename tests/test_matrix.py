@@ -1,0 +1,69 @@
+"""select_k / matrix ops tests (mirrors cpp/test/matrix/ strategy: compare
+against a host reference)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.ops import matrix
+
+
+@pytest.mark.parametrize("select_min", [True, False])
+@pytest.mark.parametrize("batch,n,k", [(4, 100, 5), (1, 37, 37), (8, 1000, 64)])
+def test_select_k(rng, select_min, batch, n, k):
+    x = rng.random((batch, n)).astype(np.float32)
+    vals, idx = matrix.select_k(x, k, select_min=select_min)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    order = np.sort(x, axis=1)
+    want = order[:, :k] if select_min else order[:, ::-1][:, :k]
+    np.testing.assert_allclose(vals, want, rtol=1e-6)
+    # indices recover values
+    np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6)
+
+
+def test_select_k_input_indices(rng):
+    x = rng.random((3, 50)).astype(np.float32)
+    src = rng.integers(0, 10_000, (3, 50)).astype(np.int32)
+    vals, idx = matrix.select_k(x, 7, input_indices=src)
+    pos = np.argsort(x, axis=1)[:, :7]
+    np.testing.assert_array_equal(np.asarray(idx), np.take_along_axis(src, pos, axis=1))
+
+
+def test_select_k_int_dtype(rng):
+    x = rng.integers(-1000, 1000, (2, 64)).astype(np.int32)
+    vals, idx = matrix.select_k(x, 5, select_min=True)
+    want = np.sort(x, axis=1)[:, :5]
+    np.testing.assert_array_equal(np.asarray(vals).astype(np.int32), want)
+
+
+def test_merge_topk(rng):
+    a = rng.random((2, 200)).astype(np.float32)
+    b = rng.random((2, 300)).astype(np.float32)
+    va, ia = matrix.select_k(a, 10)
+    vb, ib = matrix.select_k(b, 10)
+    ib = ib + 200  # global ids
+    v, i = matrix.merge_topk(va, ia, vb, ib, 10)
+    full = np.concatenate([a, b], axis=1)
+    np.testing.assert_allclose(np.asarray(v), np.sort(full, axis=1)[:, :10], rtol=1e-6)
+
+
+def test_argmax_argmin_gather(rng):
+    m = rng.random((10, 20)).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(matrix.argmax(m)), m.argmax(1))
+    np.testing.assert_array_equal(np.asarray(matrix.argmin(m)), m.argmin(1))
+    rows = np.array([3, 1, 7])
+    np.testing.assert_array_equal(np.asarray(matrix.gather(m, rows)), m[rows])
+
+
+def test_sample_rows(key, rng):
+    m = rng.random((100, 4)).astype(np.float32)
+    s = np.asarray(matrix.sample_rows(key, m, 10))
+    assert s.shape == (10, 4)
+    # every sampled row exists in m and rows are distinct
+    matches = (s[:, None, :] == m[None, :, :]).all(-1)
+    assert matches.any(1).all()
+    assert len(np.unique(matches.argmax(1))) == 10
+
+
+def test_col_wise_sort(rng):
+    m = rng.random((10, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(matrix.col_wise_sort(m)), np.sort(m, axis=0))

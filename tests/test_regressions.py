@@ -1,0 +1,74 @@
+"""Regression tests for review findings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance import pairwise_distance
+from raft_tpu.ops import matrix
+from raft_tpu.stats import silhouette_score
+
+
+def test_correlation_constant_rows():
+    """Constant rows must not blow up correlation distance."""
+    x = np.array([[1.0, 1.0, 1.0], [0.5, 1.0, 2.0]], np.float32)
+    d = np.asarray(pairwise_distance(x, x, metric="correlation"))
+    assert np.all(np.isfinite(d))
+    assert np.all(d >= -1e-5) and np.all(d <= 2.0 + 1e-5)
+
+
+def test_silhouette_empty_cluster():
+    x = np.array([[0.0, 0], [0.1, 0], [5.0, 5], [5.1, 5]], np.float32)
+    labels = np.array([0, 0, 1, 1], np.int32)
+    s2 = float(silhouette_score(x, labels, n_clusters=2))
+    s3 = float(silhouette_score(x, labels, n_clusters=3))  # cluster 2 empty
+    assert s2 == pytest.approx(s3, abs=1e-5)
+    assert s2 > 0.9
+
+
+def test_select_k_large_ints_exact():
+    """Integers above 2^24 must not lose exactness to float32."""
+    x = np.array([[16777217, 16777216, 3]], np.int32)
+    vals, idx = matrix.select_k(x, 1, select_min=False)
+    assert int(vals[0, 0]) == 16777217
+    assert int(idx[0, 0]) == 0
+    vals, idx = matrix.select_k(x, 2, select_min=True)
+    assert int(vals[0, 0]) == 3 and int(vals[0, 1]) == 16777216
+
+
+def test_comms_prod_with_negatives():
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from raft_tpu.comms import local_comms
+
+    comms = local_comms(8)
+
+    def body(x):
+        return comms.allreduce(x[0], op="prod")[None]
+
+    f = shard_map(
+        body, mesh=comms.mesh, in_specs=(P("data"),), out_specs=P("data"),
+        check_vma=False,
+    )
+    x = jnp.array([-2.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 3.0])
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, -6.0, rtol=1e-5)
+    # with a zero anywhere, product is zero
+    x0 = x.at[3].set(0.0)
+    np.testing.assert_allclose(np.asarray(f(x0)), 0.0, atol=1e-12)
+
+
+def test_sharded_knn_inner_product():
+    from raft_tpu.comms import local_comms
+    from raft_tpu.comms.distributed import sharded_knn
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.stats import neighborhood_recall
+
+    rng = np.random.default_rng(1)
+    x = rng.random((160, 8)).astype(np.float32)
+    q = rng.random((12, 8)).astype(np.float32)
+    comms = local_comms(8)
+    dv, di = sharded_knn(comms, x, q, 5, metric="inner_product")
+    sv, si = brute_force.knn(x, q, 5, metric="inner_product")
+    assert float(neighborhood_recall(np.asarray(di), np.asarray(si))) >= 0.999
