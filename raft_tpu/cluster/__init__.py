@@ -1,0 +1,26 @@
+"""Clustering: kmeans, balanced kmeans, single-linkage, spectral
+(ref: cpp/include/raft/cluster/)."""
+
+from raft_tpu.cluster.kmeans import (
+    KMeansParams,
+    fit,
+    predict,
+    fit_predict,
+    transform,
+    cluster_cost,
+    compute_new_centroids,
+    kmeans_plus_plus_init,
+)
+from raft_tpu.cluster import kmeans_balanced
+
+__all__ = [
+    "KMeansParams",
+    "fit",
+    "predict",
+    "fit_predict",
+    "transform",
+    "cluster_cost",
+    "compute_new_centroids",
+    "kmeans_plus_plus_init",
+    "kmeans_balanced",
+]

@@ -1,0 +1,197 @@
+"""Lloyd's k-means with kmeans++ init (ref: cpp/include/raft/cluster/
+kmeans.cuh, detail/kmeans.cuh (1,255 LoC), kmeans_types.hpp;
+Python ref: pylibraft.cluster.kmeans).
+
+TPU shape: the assignment step is the fused distance+argmin (one MXU matmul
+per tile, SURVEY §2.7), the update step is ``segment_sum`` (sorted
+scatter-add). The whole Lloyd loop runs on-device inside ``lax.while_loop``
+with a convergence test, so there is exactly one dispatch per ``fit``.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import distance_matrix_tile
+
+
+@dataclass
+class KMeansParams:
+    """(ref: cluster/kmeans_types.hpp KMeansParams)"""
+
+    n_clusters: int = 8
+    max_iter: int = 300
+    tol: float = 1e-4
+    init: str = "kmeans++"  # kmeans++ | random | array
+    n_init: int = 1
+    seed: int = 0
+    metric: str = "sqeuclidean"
+    batch_samples: int = 1 << 15  # mini-batch tile for assignment
+
+
+def _assign(x: jax.Array, centers: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(min_dist², label) per row — fused distance+argmin."""
+    d2 = distance_matrix_tile(x, centers, "sqeuclidean")
+    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    best = jnp.min(d2, axis=1)
+    return best, labels
+
+
+def kmeans_plus_plus_init(
+    key: jax.Array, x: jax.Array, n_clusters: int, weights: Optional[jax.Array] = None
+) -> jax.Array:
+    """kmeans++ seeding (ref: detail/kmeans.cuh kmeansPlusPlus).
+
+    Iteratively sample the next center ∝ weighted min-distance²; the
+    incremental min-d² update keeps each step a single [n, d]·[d] pass.
+    """
+    n, d = x.shape
+    w = jnp.ones((n,), x.dtype) if weights is None else weights
+    k0, key = jax.random.split(key)
+    first = jax.random.choice(k0, n, p=w / jnp.sum(w))
+    centers0 = jnp.zeros((n_clusters, d), x.dtype).at[0].set(x[first])
+    min_d2_0 = jnp.sum((x - x[first][None, :]) ** 2, axis=1)
+
+    def body(i, carry):
+        centers, min_d2, key = carry
+        key, sub = jax.random.split(key)
+        probs = w * min_d2
+        probs = probs / jnp.maximum(jnp.sum(probs), 1e-30)
+        nxt = jax.random.choice(sub, n, p=probs)
+        c = x[nxt]
+        centers = centers.at[i].set(c)
+        min_d2 = jnp.minimum(min_d2, jnp.sum((x - c[None, :]) ** 2, axis=1))
+        return centers, min_d2, key
+
+    centers, _, _ = lax.fori_loop(1, n_clusters, body, (centers0, min_d2_0, key))
+    return centers
+
+
+def compute_new_centroids(
+    x: jax.Array,
+    centroids: jax.Array,
+    labels: Optional[jax.Array] = None,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """One centroid-update step (Python ref:
+    pylibraft.cluster.kmeans.compute_new_centroids)."""
+    n_clusters = centroids.shape[0]
+    if labels is None:
+        _, labels = _assign(x, centroids)
+    w = jnp.ones((x.shape[0],), x.dtype) if weights is None else weights
+    sums = jax.ops.segment_sum(x * w[:, None], labels, num_segments=n_clusters)
+    counts = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
+    return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centroids)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _lloyd(x, centers0, weights, max_iter: int, tol: float):
+    n_clusters = centers0.shape[0]
+
+    def cond(carry):
+        _, it, prev, cur = carry
+        # relative-change of the assignment inertia between iterations;
+        # prev/cur start at +inf so the loop always takes ≥2 iterations
+        # before the test can trigger
+        return (it < max_iter) & ~(jnp.abs(prev - cur) <= tol * jnp.maximum(cur, 1e-30))
+
+    def body(carry):
+        centers, it, _, prev_inertia = carry
+        best, labels = _assign(x, centers)
+        inertia = jnp.sum(weights * best)  # inertia of THIS assignment
+        sums = jax.ops.segment_sum(x * weights[:, None], labels, num_segments=n_clusters)
+        counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
+        centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centers
+        )
+        return centers, it + 1, prev_inertia, inertia
+
+    centers, n_iter, _, _ = lax.while_loop(
+        cond, body, (centers0, jnp.int32(0), jnp.inf, jnp.inf)
+    )
+    # final inertia measured against the final centers
+    best, _ = _assign(x, centers)
+    return centers, jnp.sum(weights * best), n_iter
+
+
+def fit(
+    params: KMeansParams,
+    x: jax.Array,
+    sample_weights: Optional[jax.Array] = None,
+    *,
+    init_centers: Optional[jax.Array] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fit k-means; returns (centroids, inertia, n_iter)
+    (Python ref: pylibraft.cluster.kmeans.fit — same return triple).
+
+    ``n_init`` restarts keep the best inertia, like the reference.
+    """
+    res = ensure(res)
+    x = jnp.asarray(x, jnp.float32)
+    w = (
+        jnp.ones((x.shape[0],), jnp.float32)
+        if sample_weights is None
+        else jnp.asarray(sample_weights, jnp.float32)
+    )
+    key = jax.random.fold_in(jax.random.PRNGKey(params.seed), 0)
+
+    best = None
+    for trial in range(max(params.n_init, 1)):
+        kt = jax.random.fold_in(key, trial)
+        if init_centers is not None:
+            c0 = jnp.asarray(init_centers, jnp.float32)
+        elif params.init == "random":
+            idx = jax.random.choice(kt, x.shape[0], shape=(params.n_clusters,), replace=False)
+            c0 = x[idx]
+        else:
+            c0 = kmeans_plus_plus_init(kt, x, params.n_clusters, w)
+        centers, inertia, n_iter = _lloyd(x, c0, w, params.max_iter, params.tol)
+        if best is None or float(inertia) < float(best[1]):
+            best = (centers, inertia, n_iter)
+    return best
+
+
+def predict(
+    centroids: jax.Array,
+    x: jax.Array,
+    *,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Nearest-centroid labels (Python ref: pylibraft kmeans predict path)."""
+    x = jnp.asarray(x, jnp.float32)
+    _, labels = _assign(x, jnp.asarray(centroids, jnp.float32))
+    return labels
+
+
+def fit_predict(
+    params: KMeansParams,
+    x: jax.Array,
+    sample_weights: Optional[jax.Array] = None,
+    *,
+    res: Optional[Resources] = None,
+):
+    centroids, inertia, n_iter = fit(params, x, sample_weights, res=res)
+    return centroids, predict(centroids, x, res=res), inertia, n_iter
+
+
+def transform(centroids: jax.Array, x: jax.Array) -> jax.Array:
+    """Distances to every centroid (ref: kmeans.cuh kmeans_transform)."""
+    return distance_matrix_tile(
+        jnp.asarray(x, jnp.float32), jnp.asarray(centroids, jnp.float32), "sqeuclidean"
+    )
+
+
+def cluster_cost(
+    x: jax.Array, centroids: jax.Array, *, res: Optional[Resources] = None
+) -> jax.Array:
+    """Total inertia (Python ref: pylibraft.cluster.kmeans.cluster_cost)."""
+    best, _ = _assign(jnp.asarray(x, jnp.float32), jnp.asarray(centroids, jnp.float32))
+    return jnp.sum(best)

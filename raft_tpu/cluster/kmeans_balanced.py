@@ -1,0 +1,202 @@
+"""Hierarchical *balanced* k-means — the coarse quantizer trainer used by all
+IVF index builds.
+
+Reference: ``cluster/detail/kmeans_balanced.cuh`` (1,089 LoC) —
+``build_hierarchical`` (:952) trains ~√k mesoclusters, partitions the
+trainset, trains fine clusters per mesocluster sized proportionally
+(``build_fine_clusters`` :839), then runs balancing iterations where
+``adjust_centers`` (:521) re-seeds under-populated clusters from populous
+ones. The inner loop is fused-L2-argmin predict + reduce_rows_by_key update
+(:83-164). Public API: ``fit/predict/fit_predict``
+(cluster/kmeans_balanced.cuh:76-).
+
+TPU shape: predict is an MXU matmul tile + argmin; update is segment_sum;
+``adjust_centers`` is expressed as a jit-friendly masked teleport (small
+clusters jump to a random point of an over-populated cluster). The
+per-mesocluster fine fits share one compiled function over a padded member
+buffer (weight-0 padding), so hierarchy costs one compile.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import distance_matrix_tile
+
+
+@dataclass
+class KMeansBalancedParams:
+    """(ref: cluster/kmeans_balanced.cuh kmeans_balanced_params — n_iters is
+    the reference's `kmeans_n_iters`, default 20 in ivf types)"""
+
+    n_iters: int = 20
+    metric: str = "sqeuclidean"  # sqeuclidean | cosine (→ spherical kmeans)
+    mesocluster_threshold: int = 256  # hierarchy kicks in above this many clusters
+    seed: int = 0
+
+
+def _maybe_normalize(x: jax.Array, metric: str) -> jax.Array:
+    if metric == "cosine":
+        return x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+    return x
+
+
+def predict(
+    centers: jax.Array,
+    x: jax.Array,
+    *,
+    metric: str = "sqeuclidean",
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Labels via fused distance-argmin (ref: kmeans_balanced.cuh predict →
+    predict_core :83-164, which uses fusedL2NNMinReduce for L2)."""
+    x = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
+    c = _maybe_normalize(jnp.asarray(centers, jnp.float32), metric)
+    d2 = distance_matrix_tile(x, c, "sqeuclidean")
+    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_iters", "n_clusters"))
+def _balanced_iterations(
+    key: jax.Array,
+    x: jax.Array,
+    centers0: jax.Array,
+    weights: jax.Array,
+    n_iters: int,
+    n_clusters: int,
+):
+    """n_iters × (assign → update → adjust_centers).
+
+    adjust_centers (ref: kmeans_balanced.cuh:521): clusters with
+    count < average/ratio are re-seeded to a random trainset point drawn
+    from the data mass (points in big clusters are proportionally more
+    likely), keeping cluster sizes balanced — essential for IVF list
+    uniformity.
+    """
+    n = x.shape[0]
+
+    def body(carry, key_i):
+        centers = carry
+        d2 = distance_matrix_tile(x, centers, "sqeuclidean")
+        labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        sums = jax.ops.segment_sum(x * weights[:, None], labels, num_segments=n_clusters)
+        counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
+        centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centers
+        )
+        # --- adjust: teleport starved clusters onto random data points
+        total = jnp.sum(weights)
+        avg = total / n_clusters
+        starved = counts < avg / 8.0  # ref threshold: average/adjust ratio
+        picks = jax.random.randint(key_i, (n_clusters,), 0, n)
+        centers = jnp.where(starved[:, None], x[picks], centers)
+        return centers, counts
+
+    keys = jax.random.split(key, n_iters)
+    centers, counts_hist = lax.scan(body, centers0, keys)
+    # final clean update without adjustment
+    d2 = distance_matrix_tile(x, centers, "sqeuclidean")
+    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    sums = jax.ops.segment_sum(x * weights[:, None], labels, num_segments=n_clusters)
+    counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
+    centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centers
+    )
+    return centers, labels
+
+
+def _fit_flat(
+    key: jax.Array,
+    x: jax.Array,
+    n_clusters: int,
+    n_iters: int,
+    weights: jax.Array,
+) -> jax.Array:
+    k_init, k_iter = jax.random.split(key)
+    n = x.shape[0]
+    idx = jax.random.choice(k_init, n, shape=(n_clusters,), replace=n < n_clusters)
+    centers0 = x[idx]
+    centers, _ = _balanced_iterations(k_iter, x, centers0, weights, n_iters, n_clusters)
+    return centers
+
+
+def fit(
+    params: KMeansBalancedParams,
+    x: jax.Array,
+    n_clusters: int,
+    *,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Train n_clusters balanced centers (ref: kmeans_balanced.cuh fit →
+    detail::build_hierarchical :952)."""
+    res = ensure(res)
+    x = _maybe_normalize(jnp.asarray(x, jnp.float32), params.metric)
+    n, d = x.shape
+    key = jax.random.PRNGKey(params.seed)
+    ones = jnp.ones((n,), jnp.float32)
+
+    if n_clusters <= params.mesocluster_threshold or n < 4 * n_clusters:
+        return _fit_flat(key, x, n_clusters, params.n_iters, ones)
+
+    # ---- hierarchical path (ref: build_hierarchical :952) -----------------
+    n_meso = int(math.ceil(math.sqrt(n_clusters)))
+    k_meso, k_fine, k_final = jax.random.split(key, 3)
+    meso_centers = _fit_flat(k_meso, x, n_meso, params.n_iters, ones)
+    meso_labels = np.asarray(predict(meso_centers, x))
+
+    # fine cluster budget per mesocluster, proportional to its population
+    # (ref: build_fine_clusters :839)
+    counts = np.bincount(meso_labels, minlength=n_meso).astype(np.int64)
+    fine_k = np.maximum(1, np.floor(n_clusters * counts / max(n, 1)).astype(np.int64))
+    while fine_k.sum() != n_clusters:  # fix rounding drift
+        if fine_k.sum() < n_clusters:
+            fine_k[np.argmax(counts / fine_k)] += 1
+        else:
+            j = np.argmin(counts / np.maximum(fine_k, 1) + np.where(fine_k > 1, 0, np.inf))
+            fine_k[j] -= 1
+
+    # one compiled fine-fit over a padded member buffer per mesocluster
+    max_members = int(counts.max())
+    max_fine = int(fine_k.max())
+    x_np = np.asarray(x)
+    all_centers = []
+    for m in range(n_meso):
+        members = np.nonzero(meso_labels == m)[0]
+        if len(members) == 0:
+            continue
+        pad = max_members - len(members)
+        sel = np.concatenate([members, np.zeros((pad,), np.int64)])
+        w = np.concatenate([np.ones(len(members), np.float32), np.zeros(pad, np.float32)])
+        sub = jnp.asarray(x_np[sel])
+        centers_m = _fit_flat(
+            jax.random.fold_in(k_fine, m), sub, max_fine, params.n_iters, jnp.asarray(w)
+        )
+        all_centers.append(np.asarray(centers_m)[: int(fine_k[m])])
+    centers = jnp.asarray(np.concatenate(all_centers, axis=0))
+    assert centers.shape[0] == n_clusters, (centers.shape, n_clusters)
+
+    # final balancing passes over the full trainset (ref: :1016-1043)
+    centers, _ = _balanced_iterations(
+        k_final, x, centers, ones, max(2, params.n_iters // 10), n_clusters
+    )
+    return centers
+
+
+def fit_predict(
+    params: KMeansBalancedParams,
+    x: jax.Array,
+    n_clusters: int,
+    *,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    centers = fit(params, x, n_clusters, res=res)
+    return centers, predict(centers, x, metric=params.metric, res=res)

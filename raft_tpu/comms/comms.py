@@ -20,8 +20,8 @@ queries for orchestration code.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -137,7 +137,11 @@ class Comms:
 
     # -- host-side ---------------------------------------------------------
     def sync_stream(self, *arrays) -> None:
-        jax.block_until_ready(arrays if arrays else None)
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            # real fence: round-trip a tiny transfer so all queued work drains
+            jax.block_until_ready(jax.device_put(np.zeros(())))
 
 
 def local_comms(n_devices: Optional[int] = None) -> Comms:

@@ -16,14 +16,14 @@ workhorses, written once against ``Comms`` and run under shard_map:
 
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
+
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.comms.comms import Comms
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, distance_matrix_tile
@@ -44,17 +44,24 @@ def sharded_knn(
     the comms axis); queries are replicated. Returns replicated
     (distances [q, k], global indices [q, k]).
     """
+    if metric not in DISTANCE_TYPES:
+        raise ValueError(f"unsupported metric {metric!r}; one of {sorted(DISTANCE_TYPES)}")
     mesh = comms.mesh
     axis = comms.axis
     n = dataset_sharded.shape[0]
     size = comms.get_size()
     shard_rows = n // size
     select_min = DISTANCE_TYPES[metric] != "inner_product"
+    k_local = min(k, shard_rows)  # a shard can contribute at most its rows
 
     def local(ds_shard, q):
         rank = lax.axis_index(axis)
         dist = distance_matrix_tile(q, ds_shard, metric)
-        v, i = select_k(dist, k, select_min=select_min)
+        v, i = select_k(dist, k_local, select_min=select_min)
+        if k_local < k:  # pad so the merged pool still holds k winners
+            worst = jnp.inf if select_min else -jnp.inf
+            v = jnp.pad(v, ((0, 0), (0, k - k_local)), constant_values=worst)
+            i = jnp.pad(i, ((0, 0), (0, k - k_local)), constant_values=0)
         gi = i + rank * shard_rows  # globalize ids
         # gather all shards' candidates and reselect — merge step
         vg = lax.all_gather(v, axis, axis=1, tiled=True)  # [q, size*k]

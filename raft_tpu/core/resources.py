@@ -46,7 +46,9 @@ class Resources:
     ):
         self._factories: Dict[str, Callable[["Resources"], Any]] = {}
         self._resources: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        # reentrant: factories receive `self` and may legitimately look up
+        # other resources from inside get_resource
+        self._lock = threading.RLock()
         self._device = device
         self._mesh = mesh
         self._seed = seed

@@ -115,16 +115,28 @@ def masked_l2_nn_argmin(
     y = jnp.asarray(y)
     adj = jnp.asarray(adj)
     n = y.shape[0]
+    m = x.shape[0]
     if group_idxs is not None:
         # column j belongs to group g iff prev_end <= j < end_g
         ends = jnp.asarray(group_idxs)
-        starts = jnp.concatenate([jnp.zeros((1,), ends.dtype), ends[:-1]])
         cols = jnp.arange(n)
         group_of_col = jnp.sum(cols[None, :] >= ends[:, None], axis=0)  # [n]
         adj = adj[:, group_of_col]
 
-    dist = distance_matrix_tile(x, y, "sqeuclidean")
-    dist = jnp.where(adj, dist, jnp.inf)
-    idx = jnp.argmin(dist, axis=1).astype(jnp.int32)
-    val = jnp.take_along_axis(dist, idx[:, None], axis=1)[:, 0]
-    return val, idx
+    # row-tiled like the other fused paths, so [tile, n] is the live set
+    tile_rows = _tile_rows_for(res, n, m)
+    n_tiles = (m + tile_rows - 1) // tile_rows
+    pad = n_tiles * tile_rows - m
+    xt = jnp.pad(x, ((0, pad), (0, 0))).reshape(n_tiles, tile_rows, x.shape[1])
+    at = jnp.pad(adj, ((0, pad), (0, 0))).reshape(n_tiles, tile_rows, n)
+
+    def one_tile(args):
+        xx, aa = args
+        dist = distance_matrix_tile(xx, y, "sqeuclidean")
+        dist = jnp.where(aa, dist, jnp.inf)
+        idx = jnp.argmin(dist, axis=1).astype(jnp.int32)
+        val = jnp.take_along_axis(dist, idx[:, None], axis=1)[:, 0]
+        return val, idx
+
+    vals, idxs = lax.map(one_tile, (xt, at))
+    return vals.reshape(-1)[:m], idxs.reshape(-1)[:m]

@@ -106,6 +106,8 @@ def knn(
     res = ensure(res)
     dataset = jnp.asarray(dataset)
     queries = jnp.asarray(queries)
+    if metric not in DISTANCE_TYPES:
+        raise ValueError(f"unsupported metric {metric!r}; one of {sorted(DISTANCE_TYPES)}")
     canonical = DISTANCE_TYPES[metric]
     select_min = canonical != "inner_product"
     n, d = dataset.shape

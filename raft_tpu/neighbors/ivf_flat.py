@@ -1,0 +1,363 @@
+"""IVF-Flat: inverted-file index with uncompressed vectors.
+
+Reference: balanced-kmeans coarse quantizer + per-list vector storage,
+build/extend/search/serialize (ref: cpp/include/raft/neighbors/ivf_flat_types.hpp:47-284
+— params ``n_lists=1024``, ``kmeans_n_iters=20``, ``kmeans_trainset_fraction``,
+``adaptive_centers``; build pipeline neighbors/detail/ivf_flat_build.cuh:344;
+search = coarse select then fused interleaved scan then select_k,
+neighbors/detail/ivf_flat_search-inl.cuh:40-271; Python ref:
+pylibraft.neighbors.ivf_flat).
+
+TPU re-design of the storage layout: the reference interleaves each list in
+groups of 32 vectors × veclen for warp-coalesced scans
+(ivf_flat_build.cuh:88-154). On TPU the equivalent is a *dense padded tensor*
+``list_data [n_lists, list_cap, dim]`` — every list padded to one static
+capacity so the probe scan is a single gather + batched contraction with a
+validity mask, fully static-shaped for XLA. Balanced kmeans keeps
+``list_cap`` within a small factor of the mean list size, bounding the
+padding waste; capacity rounds up to the TPU sublane multiple (8).
+
+Search: (1) coarse: queries×centersᵀ matmul + top-n_probes (pure MXU);
+(2) gather probed lists and compute per-candidate distances with the same
+Gram decomposition used everywhere (‖y‖² precomputed per stored vector);
+(3) masked select_k over [n_probes × list_cap] candidates.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
+from raft_tpu.ops.matrix import select_k
+
+_SERIALIZATION_VERSION = 1
+
+
+@dataclass
+class IndexParams:
+    """(ref: ivf_flat_types.hpp:47 index_params)"""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+    add_data_on_build: bool = True
+    seed: int = 0
+
+
+@dataclass
+class SearchParams:
+    """(ref: ivf_flat_types.hpp search_params — n_probes)"""
+
+    n_probes: int = 20
+
+
+class Index:
+    """Padded-list IVF-Flat index.
+
+    Fields (all jnp arrays, jit-traversable):
+      centers     [n_lists, dim]     — coarse centroids
+      list_data   [n_lists, cap, dim]— padded vectors (zeros past size)
+      list_index  [n_lists, cap]     — source ids (-1 past size)
+      list_sizes  [n_lists]
+      list_norms  [n_lists, cap]     — ‖vector‖² (inf past size, so padded
+                                       slots lose every select_min)
+    """
+
+    def __init__(self, metric, centers, list_data, list_index, list_sizes, list_norms):
+        self.metric = metric
+        self.centers = centers
+        self.list_data = list_data
+        self.list_index = list_index
+        self.list_sizes = list_sizes
+        self.list_norms = list_norms
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    @property
+    def list_cap(self) -> int:
+        return self.list_data.shape[1]
+
+
+def _round_up(v: int, m: int) -> int:
+    return (v + m - 1) // m * m
+
+
+def _pack_lists(
+    dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int, metric: str
+):
+    """Scatter rows into the padded [n_lists, cap, dim] layout (host-side;
+    the analog of ivf_flat_build.cuh build_index_from_dataset list packing)."""
+    n, d = dataset.shape
+    sizes = np.bincount(labels, minlength=n_lists)
+    cap = max(8, _round_up(int(sizes.max()), 8))
+    list_data = np.zeros((n_lists, cap, d), dataset.dtype)
+    list_index = np.full((n_lists, cap), -1, np.int32)
+    order = np.argsort(labels, kind="stable")
+    sorted_rows = dataset[order]
+    sorted_ids = ids[order]
+    sorted_labels = labels[order]
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    # position of each row within its list
+    within = np.arange(n) - starts[sorted_labels]
+    list_data[sorted_labels, within] = sorted_rows
+    list_index[sorted_labels, within] = sorted_ids
+    norms = np.full((n_lists, cap), np.inf, np.float32)
+    valid = list_index >= 0
+    norms[valid] = (list_data.astype(np.float32) ** 2).sum(-1)[valid]
+    return (
+        jnp.asarray(list_data),
+        jnp.asarray(list_index),
+        jnp.asarray(sizes.astype(np.int32)),
+        jnp.asarray(norms),
+    )
+
+
+def build(
+    params: IndexParams,
+    dataset: jax.Array,
+    *,
+    res: Optional[Resources] = None,
+) -> Index:
+    """(ref: ivf_flat build pipeline, detail/ivf_flat_build.cuh:344 —
+    subsample trainset → kmeans_balanced::fit → predict → pack lists)"""
+    res = ensure(res)
+    dataset = jnp.asarray(dataset)
+    n, d = dataset.shape
+    canonical = DISTANCE_TYPES[params.metric]
+    if canonical not in ("sqeuclidean", "euclidean", "inner_product", "cosine"):
+        raise ValueError(f"ivf_flat supports L2/IP/cosine metrics, got {params.metric}")
+
+    kb_metric = "cosine" if canonical == "cosine" else "sqeuclidean"
+    kb = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=kb_metric, seed=params.seed
+    )
+    n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
+    if n_train < n:
+        key = jax.random.PRNGKey(params.seed)
+        train_idx = jax.random.choice(key, n, shape=(n_train,), replace=False)
+        trainset = dataset[train_idx]
+    else:
+        trainset = dataset
+    centers = kmeans_balanced.fit(kb, trainset.astype(jnp.float32), params.n_lists, res=res)
+
+    index = Index(
+        params.metric,
+        centers,
+        jnp.zeros((params.n_lists, 8, d), dataset.dtype),
+        jnp.full((params.n_lists, 8), -1, jnp.int32),
+        jnp.zeros((params.n_lists,), jnp.int32),
+        jnp.full((params.n_lists, 8), jnp.inf, jnp.float32),
+    )
+    if params.add_data_on_build:
+        index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
+    return index
+
+
+def extend(
+    index: Index,
+    new_vectors: jax.Array,
+    new_indices: Optional[jax.Array] = None,
+    *,
+    res: Optional[Resources] = None,
+) -> Index:
+    """Add vectors (ref: ivf_flat extend, detail/ivf_flat_build.cuh:163).
+
+    Capacity changes re-pack the padded layout host-side; search recompiles
+    only when ``list_cap`` crosses its next padded tier — the explicit
+    recompile-tier strategy for XLA static shapes (SURVEY §7 hard part 4).
+    """
+    res = ensure(res)
+    new_vectors = jnp.asarray(new_vectors, index.list_data.dtype)
+    canonical = DISTANCE_TYPES[index.metric]
+    labels = kmeans_balanced.predict(
+        index.centers,
+        new_vectors.astype(jnp.float32),
+        metric="cosine" if canonical == "cosine" else "sqeuclidean",
+        res=res,
+    )
+    old_n = index.size
+    if new_indices is None:
+        new_indices = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
+
+    # merge with existing content host-side, then re-pack
+    old_valid = np.asarray(index.list_index) >= 0
+    old_rows = np.asarray(index.list_data)[old_valid]
+    old_ids = np.asarray(index.list_index)[old_valid]
+    old_labels = np.repeat(np.arange(index.n_lists), np.asarray(old_valid.sum(1)))
+
+    all_rows = np.concatenate([old_rows, np.asarray(new_vectors)])
+    all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
+    all_labels = np.concatenate([old_labels.astype(np.int32), np.asarray(labels)])
+    list_data, list_index, list_sizes, list_norms = _pack_lists(
+        all_rows, all_ids, all_labels, index.n_lists, index.metric
+    )
+    return Index(index.metric, index.centers, list_data, list_index, list_sizes, list_norms)
+
+
+@functools.partial(jax.jit, static_argnames=("n_probes", "k", "metric", "query_tile"))
+def _search_jit(
+    queries,      # [q, d] f32
+    centers,      # [L, d] f32
+    list_data,    # [L, cap, d]
+    list_index,   # [L, cap] int32
+    list_norms,   # [L, cap] f32 (inf at padding)
+    filter_words, # [W] uint32 or None-like all-ones
+    n_probes: int,
+    k: int,
+    metric: str,
+    query_tile: int,
+):
+    q, d = queries.shape
+    cap = list_data.shape[1]
+    select_min = metric != "inner_product"
+
+    # ---- coarse: select n_probes lists (ref: ivf_flat_search-inl.cuh:40,
+    # GEMM + select_k — same shape here)
+    if metric == "cosine":
+        qn = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+        cn = centers / jnp.maximum(jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
+        coarse = -jnp.matmul(qn, cn.T, precision=_PREC)
+    elif metric == "inner_product":
+        coarse = -jnp.matmul(queries, centers.T, precision=_PREC)
+    else:
+        cnorm = jnp.sum(centers * centers, axis=1)
+        coarse = cnorm[None, :] - 2.0 * jnp.matmul(queries, centers.T, precision=_PREC)
+    _, probes = select_k(coarse, n_probes, select_min=True)  # [q, p]
+
+    n_tiles = (q + query_tile - 1) // query_tile
+    pad_q = n_tiles * query_tile - q
+    qt = jnp.pad(queries, ((0, pad_q), (0, 0))).reshape(n_tiles, query_tile, d)
+    pt = jnp.pad(probes, ((0, pad_q), (0, 0))).reshape(n_tiles, query_tile, n_probes)
+
+    def tile(args):
+        qq, pp = args  # [t, d], [t, p]
+        data = list_data[pp].astype(jnp.float32)      # [t, p, cap, d] gather
+        ids = list_index[pp]                          # [t, p, cap]
+        norms = list_norms[pp]                        # [t, p, cap]
+        # distance epilogue per metric
+        ip = jnp.einsum("td,tpcd->tpc", qq, data, precision=_PREC)
+        if metric == "inner_product":
+            dist = -ip
+        elif metric == "cosine":
+            qn = jnp.maximum(jnp.linalg.norm(qq, axis=1), 1e-12)  # [t]
+            vn = jnp.sqrt(jnp.maximum(norms, 1e-24))
+            dist = 1.0 - ip / (qn[:, None, None] * vn)
+        else:  # sqeuclidean/euclidean: ‖y‖² − 2x·y (+‖x‖² later, rank-stable)
+            dist = norms - 2.0 * ip
+        invalid = ids < 0
+        if filter_words is not None:
+            word = filter_words[jnp.clip(ids, 0, None) // 32]
+            bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
+            invalid = invalid | (bit == 0)
+        worst = jnp.inf
+        dist = jnp.where(invalid, worst, dist)
+        flat_d = dist.reshape(query_tile, n_probes * cap)
+        flat_i = ids.reshape(query_tile, n_probes * cap)
+        v, i = select_k(flat_d, k, select_min=True, input_indices=flat_i)
+        if metric == "inner_product":
+            v = -v
+        elif metric == "euclidean":
+            qq2 = jnp.sum(qq * qq, axis=1)
+            v = jnp.sqrt(jnp.maximum(v + qq2[:, None], 0.0))
+        elif metric == "sqeuclidean":
+            qq2 = jnp.sum(qq * qq, axis=1)
+            v = v + qq2[:, None]
+        return v, i
+
+    vals, idx = lax.map(tile, (qt, pt))
+    return (
+        vals.reshape(n_tiles * query_tile, k)[:q],
+        idx.reshape(n_tiles * query_tile, k)[:q],
+    )
+
+
+def search(
+    params: SearchParams,
+    index: Index,
+    queries: jax.Array,
+    k: int,
+    *,
+    sample_filter: Optional[Bitset] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (distances [q, k], indices [q, k]); indices −1 never appear
+    unless a list underfills k (then distance is +inf)."""
+    res = ensure(res)
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries shape {queries.shape} vs index dim {index.dim}")
+    n_probes = min(params.n_probes, index.n_lists)
+    if k > n_probes * index.list_cap:
+        raise ValueError(
+            f"k={k} exceeds the candidate pool n_probes*list_cap="
+            f"{n_probes}*{index.list_cap}; raise n_probes"
+        )
+    canonical = DISTANCE_TYPES[index.metric]
+    # tile queries so the [t, p, cap, d] gather respects the workspace budget
+    per_q = 4 * n_probes * index.list_cap * (index.dim + 2)
+    query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=256))))
+    fw = sample_filter.words if sample_filter is not None else None
+    return _search_jit(
+        queries,
+        index.centers,
+        index.list_data,
+        index.list_index,
+        index.list_norms,
+        fw,
+        n_probes,
+        int(k),
+        canonical,
+        query_tile,
+    )
+
+
+def save(filename: str, index: Index) -> None:
+    ser.save_tree(
+        filename,
+        "ivf_flat",
+        _SERIALIZATION_VERSION,
+        {"metric": index.metric},
+        {
+            "centers": index.centers,
+            "list_data": index.list_data,
+            "list_index": index.list_index,
+            "list_sizes": index.list_sizes,
+            "list_norms": index.list_norms,
+        },
+    )
+
+
+def load(filename: str) -> Index:
+    scalars, arrays = ser.load_tree(filename, "ivf_flat", _SERIALIZATION_VERSION)
+    return Index(
+        scalars["metric"],
+        jnp.asarray(arrays["centers"]),
+        jnp.asarray(arrays["list_data"]),
+        jnp.asarray(arrays["list_index"]),
+        jnp.asarray(arrays["list_sizes"]),
+        jnp.asarray(arrays["list_norms"]),
+    )

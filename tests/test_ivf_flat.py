@@ -1,0 +1,111 @@
+"""IVF-Flat: recall gates vs brute force (mirrors cpp/test/neighbors/
+ann_ivf_flat recall thresholds + pylibraft test_ivf_flat)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.random import make_blobs
+from raft_tpu.stats import neighborhood_recall
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def data():
+    key = jax.random.PRNGKey(0)
+    x, _, _ = make_blobs(key, 8000, 32, n_clusters=30, cluster_std=2.0)
+    q = jax.random.normal(jax.random.PRNGKey(1), (64, 32)) * 4.0
+    return np.asarray(x), np.asarray(q)
+
+
+@pytest.fixture(scope="module")
+def built(data):
+    x, _ = data
+    params = ivf_flat.IndexParams(n_lists=64, kmeans_n_iters=10, seed=0)
+    return ivf_flat.build(params, x)
+
+
+def test_build_properties(built, data):
+    x, _ = data
+    assert built.n_lists == 64
+    assert built.size == x.shape[0]
+    sizes = np.asarray(built.list_sizes)
+    assert sizes.sum() == x.shape[0]
+    # padded ids valid
+    ids = np.asarray(built.list_index)
+    got = np.sort(ids[ids >= 0])
+    np.testing.assert_array_equal(got, np.arange(x.shape[0]))
+
+
+@pytest.mark.parametrize("n_probes,min_recall", [(8, 0.75), (32, 0.98), (64, 0.9999)])
+def test_recall_vs_bruteforce(built, data, n_probes, min_recall):
+    x, q = data
+    k = 10
+    _, gt = brute_force.knn(x, q, k)
+    dist, idx = ivf_flat.search(ivf_flat.SearchParams(n_probes=n_probes), built, q, k)
+    r = float(neighborhood_recall(np.asarray(idx), np.asarray(gt)))
+    assert r >= min_recall, (n_probes, r)
+
+
+def test_full_probe_distances_exact(built, data):
+    """With n_probes == n_lists results must equal brute force."""
+    x, q = data
+    gt_d, gt_i = brute_force.knn(x, q, 5, metric="sqeuclidean")
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=64), built, q, 5)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(gt_d), rtol=1e-3, atol=1e-3)
+
+
+def test_extend(data):
+    x, q = data
+    params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=5, add_data_on_build=False)
+    index = ivf_flat.build(params, x)
+    assert index.size == 0
+    index = ivf_flat.extend(index, x[:5000], np.arange(5000, dtype=np.int32))
+    index = ivf_flat.extend(
+        index, x[5000:], np.arange(5000, x.shape[0], dtype=np.int32)
+    )
+    assert index.size == x.shape[0]
+    _, gt = brute_force.knn(x, q, 10)
+    _, idx = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), index, q, 10)
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.99
+
+
+def test_bitset_prefilter(built, data):
+    """(ref: neighbors/sample_filter_types.hpp bitset_filter)"""
+    x, q = data
+    n = x.shape[0]
+    # exclude even ids
+    mask = np.arange(n) % 2 == 1
+    bs = Bitset.from_mask(jnp.asarray(mask))
+    _, idx = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=64), built, q, 10, sample_filter=bs
+    )
+    idx = np.asarray(idx)
+    assert (idx % 2 == 1).all()
+    # matches filtered brute force
+    sub = np.nonzero(mask)[0]
+    _, gt_sub = brute_force.knn(x[sub], q, 10)
+    gt = sub[np.asarray(gt_sub)]
+    assert float(neighborhood_recall(idx, gt)) >= 0.999
+
+
+def test_save_load_roundtrip(built, data, tmp_path):
+    x, q = data
+    fn = str(tmp_path / "ivf.idx")
+    ivf_flat.save(fn, built)
+    loaded = ivf_flat.load(fn)
+    d1, i1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), built, q, 5)
+    d2, i2 = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), loaded, q, 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_inner_product_metric(data):
+    x, q = data
+    params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8, metric="inner_product")
+    index = ivf_flat.build(params, x)
+    _, gt = brute_force.knn(x, q, 10, metric="inner_product")
+    _, idx = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), index, q, 10)
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.99
