@@ -32,16 +32,32 @@ class KMeansParams:
     init: str = "kmeans++"  # kmeans++ | random | array
     n_init: int = 1
     seed: int = 0
-    metric: str = "sqeuclidean"
-    batch_samples: int = 1 << 15  # mini-batch tile for assignment
+    metric: str = "sqeuclidean"  # sqeuclidean | cosine (spherical k-means)
+    batch_samples: int = 1 << 15  # assignment row-tile (bounds the [tile, k] matrix)
 
 
-def _assign(x: jax.Array, centers: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """(min_dist², label) per row — fused distance+argmin."""
-    d2 = distance_matrix_tile(x, centers, "sqeuclidean")
-    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
-    best = jnp.min(d2, axis=1)
-    return best, labels
+def _normalize_rows(x: jax.Array) -> jax.Array:
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+
+
+def _assign(
+    x: jax.Array, centers: jax.Array, tile: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """(min_dist², label) per row — fused distance+argmin, row-tiled so the
+    [tile, k] distance matrix (not [n, k]) bounds the workspace."""
+
+    def one(t):
+        d2 = distance_matrix_tile(t, centers, "sqeuclidean")
+        return jnp.min(d2, axis=1), jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    n = x.shape[0]
+    if tile <= 0 or n <= tile:
+        return one(x)
+    n_tiles = (n + tile - 1) // tile
+    pad = n_tiles * tile - n
+    xp = jnp.pad(x, ((0, pad), (0, 0))).reshape(n_tiles, tile, x.shape[1])
+    best, labels = lax.map(one, xp)
+    return best.reshape(-1)[:n], labels.reshape(-1)[:n]
 
 
 def kmeans_plus_plus_init(
@@ -91,9 +107,10 @@ def compute_new_centroids(
     return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centroids)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter",))
-def _lloyd(x, centers0, weights, max_iter: int, tol: float):
+@functools.partial(jax.jit, static_argnames=("max_iter", "metric", "tile"))
+def _lloyd(x, centers0, weights, max_iter: int, tol: float, metric: str, tile: int):
     n_clusters = centers0.shape[0]
+    spherical = metric == "cosine"
 
     def cond(carry):
         _, it, prev, cur = carry
@@ -104,20 +121,24 @@ def _lloyd(x, centers0, weights, max_iter: int, tol: float):
 
     def body(carry):
         centers, it, _, prev_inertia = carry
-        best, labels = _assign(x, centers)
+        best, labels = _assign(x, centers, tile)
         inertia = jnp.sum(weights * best)  # inertia of THIS assignment
         sums = jax.ops.segment_sum(x * weights[:, None], labels, num_segments=n_clusters)
         counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
         centers = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centers
         )
+        if spherical:
+            # spherical k-means: centers live on the unit sphere, so the
+            # sqeuclidean argmin stays rank-equivalent to cosine
+            centers = _normalize_rows(centers)
         return centers, it + 1, prev_inertia, inertia
 
     centers, n_iter, _, _ = lax.while_loop(
         cond, body, (centers0, jnp.int32(0), jnp.inf, jnp.inf)
     )
     # final inertia measured against the final centers
-    best, _ = _assign(x, centers)
+    best, _ = _assign(x, centers, tile)
     return centers, jnp.sum(weights * best), n_iter
 
 
@@ -135,25 +156,38 @@ def fit(
     ``n_init`` restarts keep the best inertia, like the reference.
     """
     res = ensure(res)
+    if params.metric not in ("sqeuclidean", "euclidean", "l2", "cosine"):
+        raise ValueError(f"kmeans supports sqeuclidean/cosine, got {params.metric}")
+    metric = "cosine" if params.metric == "cosine" else "sqeuclidean"
     x = jnp.asarray(x, jnp.float32)
+    if metric == "cosine":
+        x = _normalize_rows(x)
     w = (
         jnp.ones((x.shape[0],), jnp.float32)
         if sample_weights is None
         else jnp.asarray(sample_weights, jnp.float32)
     )
     key = jax.random.fold_in(jax.random.PRNGKey(params.seed), 0)
+    if params.init == "array" and init_centers is None:
+        raise ValueError("init='array' requires init_centers")
 
+    # deterministic restarts are identical — an explicit init runs once
+    n_init = 1 if init_centers is not None else max(params.n_init, 1)
     best = None
-    for trial in range(max(params.n_init, 1)):
+    for trial in range(n_init):
         kt = jax.random.fold_in(key, trial)
         if init_centers is not None:
             c0 = jnp.asarray(init_centers, jnp.float32)
+            if metric == "cosine":
+                c0 = _normalize_rows(c0)
         elif params.init == "random":
             idx = jax.random.choice(kt, x.shape[0], shape=(params.n_clusters,), replace=False)
             c0 = x[idx]
         else:
             c0 = kmeans_plus_plus_init(kt, x, params.n_clusters, w)
-        centers, inertia, n_iter = _lloyd(x, c0, w, params.max_iter, params.tol)
+        centers, inertia, n_iter = _lloyd(
+            x, c0, w, params.max_iter, params.tol, metric, params.batch_samples
+        )
         if best is None or float(inertia) < float(best[1]):
             best = (centers, inertia, n_iter)
     return best
@@ -163,11 +197,16 @@ def predict(
     centroids: jax.Array,
     x: jax.Array,
     *,
+    metric: str = "sqeuclidean",
+    batch_samples: int = 1 << 15,
     res: Optional[Resources] = None,
 ) -> jax.Array:
     """Nearest-centroid labels (Python ref: pylibraft kmeans predict path)."""
     x = jnp.asarray(x, jnp.float32)
-    _, labels = _assign(x, jnp.asarray(centroids, jnp.float32))
+    c = jnp.asarray(centroids, jnp.float32)
+    if metric == "cosine":
+        x, c = _normalize_rows(x), _normalize_rows(c)
+    _, labels = _assign(x, c, batch_samples)
     return labels
 
 
@@ -179,7 +218,10 @@ def fit_predict(
     res: Optional[Resources] = None,
 ):
     centroids, inertia, n_iter = fit(params, x, sample_weights, res=res)
-    return centroids, predict(centroids, x, res=res), inertia, n_iter
+    labels = predict(
+        centroids, x, metric=params.metric, batch_samples=params.batch_samples, res=res
+    )
+    return centroids, labels, inertia, n_iter
 
 
 def transform(centroids: jax.Array, x: jax.Array) -> jax.Array:
@@ -190,8 +232,14 @@ def transform(centroids: jax.Array, x: jax.Array) -> jax.Array:
 
 
 def cluster_cost(
-    x: jax.Array, centroids: jax.Array, *, res: Optional[Resources] = None
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    batch_samples: int = 1 << 15,
+    res: Optional[Resources] = None,
 ) -> jax.Array:
     """Total inertia (Python ref: pylibraft.cluster.kmeans.cluster_cost)."""
-    best, _ = _assign(jnp.asarray(x, jnp.float32), jnp.asarray(centroids, jnp.float32))
+    best, _ = _assign(
+        jnp.asarray(x, jnp.float32), jnp.asarray(centroids, jnp.float32), batch_samples
+    )
     return jnp.sum(best)

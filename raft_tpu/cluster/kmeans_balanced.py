@@ -39,7 +39,7 @@ class KMeansBalancedParams:
     the reference's `kmeans_n_iters`, default 20 in ivf types)"""
 
     n_iters: int = 20
-    metric: str = "sqeuclidean"  # sqeuclidean | cosine (→ spherical kmeans)
+    metric: str = "sqeuclidean"  # sqeuclidean | cosine (spherical) | inner_product
     mesocluster_threshold: int = 256  # hierarchy kicks in above this many clusters
     seed: int = 0
 
@@ -58,14 +58,19 @@ def predict(
     res: Optional[Resources] = None,
 ) -> jax.Array:
     """Labels via fused distance-argmin (ref: kmeans_balanced.cuh predict →
-    predict_core :83-164, which uses fusedL2NNMinReduce for L2)."""
+    predict_core :83-164, which uses fusedL2NNMinReduce for L2 and
+    pairwise_distance+argmin for other metrics — the metric MUST match the
+    one used at build so list membership and probe ranking agree)."""
     x = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
     c = _maybe_normalize(jnp.asarray(centers, jnp.float32), metric)
-    d2 = distance_matrix_tile(x, c, "sqeuclidean")
-    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+    if metric == "inner_product":
+        d = -jnp.matmul(x, c.T, precision=jax.lax.Precision.HIGHEST)
+    else:
+        d = distance_matrix_tile(x, c, "sqeuclidean")
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("n_iters", "n_clusters"))
+@functools.partial(jax.jit, static_argnames=("n_iters", "n_clusters", "metric"))
 def _balanced_iterations(
     key: jax.Array,
     x: jax.Array,
@@ -73,6 +78,7 @@ def _balanced_iterations(
     weights: jax.Array,
     n_iters: int,
     n_clusters: int,
+    metric: str = "sqeuclidean",
 ):
     """n_iters × (assign → update → adjust_centers).
 
@@ -83,34 +89,47 @@ def _balanced_iterations(
     uniformity.
     """
     n = x.shape[0]
+    spherical = metric == "cosine"
+
+    def assign(centers):
+        if metric == "inner_product":
+            d = -jnp.matmul(x, centers.T, precision=jax.lax.Precision.HIGHEST)
+        else:
+            d = distance_matrix_tile(x, centers, "sqeuclidean")
+        return jnp.argmin(d, axis=1).astype(jnp.int32)
 
     def body(carry, key_i):
         centers = carry
-        d2 = distance_matrix_tile(x, centers, "sqeuclidean")
-        labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        labels = assign(centers)
         sums = jax.ops.segment_sum(x * weights[:, None], labels, num_segments=n_clusters)
         counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
         centers = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centers
         )
-        # --- adjust: teleport starved clusters onto random data points
+        if spherical:
+            centers = _maybe_normalize(centers, "cosine")
+        # --- adjust: teleport starved clusters onto random data points,
+        # sampled ∝ weight so weight-0 padding rows are never chosen
         total = jnp.sum(weights)
         avg = total / n_clusters
         starved = counts < avg / 8.0  # ref threshold: average/adjust ratio
-        picks = jax.random.randint(key_i, (n_clusters,), 0, n)
+        picks = jax.random.categorical(
+            key_i, jnp.where(weights > 0, 0.0, -jnp.inf), shape=(n_clusters,)
+        )
         centers = jnp.where(starved[:, None], x[picks], centers)
         return centers, counts
 
     keys = jax.random.split(key, n_iters)
     centers, counts_hist = lax.scan(body, centers0, keys)
     # final clean update without adjustment
-    d2 = distance_matrix_tile(x, centers, "sqeuclidean")
-    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    labels = assign(centers)
     sums = jax.ops.segment_sum(x * weights[:, None], labels, num_segments=n_clusters)
     counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
     centers = jnp.where(
         counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centers
     )
+    if spherical:
+        centers = _maybe_normalize(centers, "cosine")
     return centers, labels
 
 
@@ -120,12 +139,18 @@ def _fit_flat(
     n_clusters: int,
     n_iters: int,
     weights: jax.Array,
+    metric: str = "sqeuclidean",
 ) -> jax.Array:
     k_init, k_iter = jax.random.split(key)
     n = x.shape[0]
-    idx = jax.random.choice(k_init, n, shape=(n_clusters,), replace=n < n_clusters)
+    # init ∝ weight so weight-0 padding rows are never seeds
+    idx = jax.random.categorical(
+        k_init, jnp.where(weights > 0, 0.0, -jnp.inf), shape=(n_clusters,)
+    )
     centers0 = x[idx]
-    centers, _ = _balanced_iterations(k_iter, x, centers0, weights, n_iters, n_clusters)
+    centers, _ = _balanced_iterations(
+        k_iter, x, centers0, weights, n_iters, n_clusters, metric
+    )
     return centers
 
 
@@ -139,46 +164,58 @@ def fit(
     """Train n_clusters balanced centers (ref: kmeans_balanced.cuh fit →
     detail::build_hierarchical :952)."""
     res = ensure(res)
-    x = _maybe_normalize(jnp.asarray(x, jnp.float32), params.metric)
+    metric = params.metric
+    x = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
     n, d = x.shape
     key = jax.random.PRNGKey(params.seed)
     ones = jnp.ones((n,), jnp.float32)
 
     if n_clusters <= params.mesocluster_threshold or n < 4 * n_clusters:
-        return _fit_flat(key, x, n_clusters, params.n_iters, ones)
+        return _fit_flat(key, x, n_clusters, params.n_iters, ones, metric)
 
     # ---- hierarchical path (ref: build_hierarchical :952) -----------------
     n_meso = int(math.ceil(math.sqrt(n_clusters)))
     k_meso, k_fine, k_final = jax.random.split(key, 3)
-    meso_centers = _fit_flat(k_meso, x, n_meso, params.n_iters, ones)
-    meso_labels = np.asarray(predict(meso_centers, x))
+    meso_centers = _fit_flat(k_meso, x, n_meso, params.n_iters, ones, metric)
+    # x is already normalized for cosine (normalizing again is idempotent),
+    # so this assignment matches the training metric
+    meso_labels = np.asarray(predict(meso_centers, x, metric=metric))
 
-    # fine cluster budget per mesocluster, proportional to its population
-    # (ref: build_fine_clusters :839)
+    # fine cluster budget per mesocluster, proportional to its population;
+    # empty mesoclusters get 0 fine clusters (ref: build_fine_clusters :839)
     counts = np.bincount(meso_labels, minlength=n_meso).astype(np.int64)
-    fine_k = np.maximum(1, np.floor(n_clusters * counts / max(n, 1)).astype(np.int64))
+    fine_k = np.where(
+        counts > 0,
+        np.maximum(1, np.floor(n_clusters * counts / max(n, 1)).astype(np.int64)),
+        0,
+    )
+    occupied = counts > 0
     while fine_k.sum() != n_clusters:  # fix rounding drift
         if fine_k.sum() < n_clusters:
-            fine_k[np.argmax(counts / fine_k)] += 1
+            load = np.where(occupied, counts / np.maximum(fine_k, 1), -np.inf)
+            fine_k[np.argmax(load)] += 1
         else:
-            j = np.argmin(counts / np.maximum(fine_k, 1) + np.where(fine_k > 1, 0, np.inf))
-            fine_k[j] -= 1
+            load = np.where(fine_k > 1, counts / np.maximum(fine_k, 1), np.inf)
+            fine_k[np.argmin(load)] -= 1
 
-    # one compiled fine-fit over a padded member buffer per mesocluster
+    # one compiled fine-fit over a padded member buffer per mesocluster;
+    # padding repeats the mesocluster's own members (weight 0) so random
+    # seeds/teleports can never land outside the partition
     max_members = int(counts.max())
     max_fine = int(fine_k.max())
     x_np = np.asarray(x)
     all_centers = []
     for m in range(n_meso):
         members = np.nonzero(meso_labels == m)[0]
-        if len(members) == 0:
+        if len(members) == 0 or fine_k[m] == 0:
             continue
         pad = max_members - len(members)
-        sel = np.concatenate([members, np.zeros((pad,), np.int64)])
+        sel = np.concatenate([members, members[np.arange(pad) % len(members)]])
         w = np.concatenate([np.ones(len(members), np.float32), np.zeros(pad, np.float32)])
         sub = jnp.asarray(x_np[sel])
         centers_m = _fit_flat(
-            jax.random.fold_in(k_fine, m), sub, max_fine, params.n_iters, jnp.asarray(w)
+            jax.random.fold_in(k_fine, m), sub, max_fine, params.n_iters,
+            jnp.asarray(w), metric,
         )
         all_centers.append(np.asarray(centers_m)[: int(fine_k[m])])
     centers = jnp.asarray(np.concatenate(all_centers, axis=0))
@@ -186,7 +223,7 @@ def fit(
 
     # final balancing passes over the full trainset (ref: :1016-1043)
     centers, _ = _balanced_iterations(
-        k_final, x, centers, ones, max(2, params.n_iters // 10), n_clusters
+        k_final, x, centers, ones, max(2, params.n_iters // 10), n_clusters, metric
     )
     return centers
 

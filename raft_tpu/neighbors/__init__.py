@@ -1,5 +1,6 @@
 """Nearest-neighbor indexes (ref: cpp/include/raft/neighbors/)."""
 
-from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors.refine import refine
 
-__all__ = ["brute_force"]
+__all__ = ["brute_force", "ivf_flat", "ivf_pq", "refine"]

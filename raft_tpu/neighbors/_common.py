@@ -1,0 +1,81 @@
+"""Shared helpers for the IVF index family.
+
+Padded-list packing, coarse cluster selection, and bitset-filter masking are
+identical between IVF-Flat and IVF-PQ (ref: the reference shares them via
+``neighbors/ivf_list.hpp`` + ``detail/ivf_common.cuh``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.distance.pairwise import _PREC
+from raft_tpu.ops.matrix import select_k
+
+
+def round_up(v: int, m: int) -> int:
+    return (v + m - 1) // m * m
+
+
+def pack_padded_lists(
+    payload: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Scatter rows into the padded [n_lists, cap, ...] layout (host-side;
+    the analog of the reference's per-list code/vector packing,
+    ivf_flat_build.cuh:88-154). Returns (list_payload, list_index, sizes);
+    cap is the max list size rounded up to the sublane multiple (8)."""
+    n = payload.shape[0]
+    sizes = np.bincount(labels, minlength=n_lists)
+    cap = max(8, round_up(int(sizes.max()) if n else 8, 8))
+    list_payload = np.zeros((n_lists, cap) + payload.shape[1:], payload.dtype)
+    list_index = np.full((n_lists, cap), -1, np.int32)
+    order = np.argsort(labels, kind="stable")
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    within = np.arange(n) - starts[labels[order]]
+    list_payload[labels[order], within] = payload[order]
+    list_index[labels[order], within] = ids[order]
+    return list_payload, list_index, sizes.astype(np.int32)
+
+
+def unpack_lists(
+    list_payload: np.ndarray, list_index: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse of pack_padded_lists → (payload, ids, labels) host arrays."""
+    valid = list_index >= 0
+    payload = list_payload[valid]
+    ids = list_index[valid]
+    labels = np.repeat(np.arange(list_index.shape[0]), valid.sum(1)).astype(np.int32)
+    return payload, ids, labels
+
+
+def coarse_select(
+    queries: jax.Array, centers: jax.Array, metric: str, n_probes: int
+) -> jax.Array:
+    """Top-n_probes cluster ids per query: one MXU GEMM + select_k
+    (ref: ivf_pq_search.cuh select_clusters:67, ivf_flat_search-inl.cuh:40)."""
+    if metric == "cosine":
+        qn = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+        cn = centers / jnp.maximum(jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
+        coarse = -jnp.matmul(qn, cn.T, precision=_PREC)
+    elif metric == "inner_product":
+        coarse = -jnp.matmul(queries, centers.T, precision=_PREC)
+    else:
+        cnorm = jnp.sum(centers * centers, axis=1)
+        coarse = cnorm[None, :] - 2.0 * jnp.matmul(queries, centers.T, precision=_PREC)
+    _, probes = select_k(coarse, n_probes, select_min=True)
+    return probes
+
+
+def invalid_mask(ids: jax.Array, filter_words: Optional[jax.Array]) -> jax.Array:
+    """Candidate mask: padding slots plus bitset-filtered ids
+    (ref: neighbors/sample_filter_types.hpp bitset_filter)."""
+    invalid = ids < 0
+    if filter_words is not None:
+        word = filter_words[jnp.clip(ids, 0, None) // 32]
+        bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
+        invalid = invalid | (bit == 0)
+    return invalid

@@ -39,6 +39,12 @@ from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
+from raft_tpu.neighbors._common import (
+    coarse_select,
+    invalid_mask,
+    pack_padded_lists,
+    unpack_lists,
+)
 from raft_tpu.ops.matrix import select_k
 
 _SERIALIZATION_VERSION = 1
@@ -101,37 +107,18 @@ class Index:
         return self.list_data.shape[1]
 
 
-def _round_up(v: int, m: int) -> int:
-    return (v + m - 1) // m * m
-
-
 def _pack_lists(
     dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int, metric: str
 ):
-    """Scatter rows into the padded [n_lists, cap, dim] layout (host-side;
-    the analog of ivf_flat_build.cuh build_index_from_dataset list packing)."""
-    n, d = dataset.shape
-    sizes = np.bincount(labels, minlength=n_lists)
-    cap = max(8, _round_up(int(sizes.max()), 8))
-    list_data = np.zeros((n_lists, cap, d), dataset.dtype)
-    list_index = np.full((n_lists, cap), -1, np.int32)
-    order = np.argsort(labels, kind="stable")
-    sorted_rows = dataset[order]
-    sorted_ids = ids[order]
-    sorted_labels = labels[order]
-    starts = np.zeros(n_lists + 1, np.int64)
-    np.cumsum(sizes, out=starts[1:])
-    # position of each row within its list
-    within = np.arange(n) - starts[sorted_labels]
-    list_data[sorted_labels, within] = sorted_rows
-    list_index[sorted_labels, within] = sorted_ids
-    norms = np.full((n_lists, cap), np.inf, np.float32)
+    """Pack into the padded [n_lists, cap, dim] layout + per-slot norms."""
+    list_data, list_index, sizes = pack_padded_lists(dataset, ids, labels, n_lists)
+    norms = np.full(list_index.shape, np.inf, np.float32)
     valid = list_index >= 0
     norms[valid] = (list_data.astype(np.float32) ** 2).sum(-1)[valid]
     return (
         jnp.asarray(list_data),
         jnp.asarray(list_index),
-        jnp.asarray(sizes.astype(np.int32)),
+        jnp.asarray(sizes),
         jnp.asarray(norms),
     )
 
@@ -151,7 +138,10 @@ def build(
     if canonical not in ("sqeuclidean", "euclidean", "inner_product", "cosine"):
         raise ValueError(f"ivf_flat supports L2/IP/cosine metrics, got {params.metric}")
 
-    kb_metric = "cosine" if canonical == "cosine" else "sqeuclidean"
+    # train the coarse quantizer under the index metric so list membership
+    # agrees with the probe ranking at search time (ref: ivf_flat build uses
+    # index.metric for kmeans_balanced — detail/ivf_flat_build.cuh:360)
+    kb_metric = canonical if canonical in ("cosine", "inner_product") else "sqeuclidean"
     kb = kmeans_balanced.KMeansBalancedParams(
         n_iters=params.kmeans_n_iters, metric=kb_metric, seed=params.seed
     )
@@ -196,7 +186,7 @@ def extend(
     labels = kmeans_balanced.predict(
         index.centers,
         new_vectors.astype(jnp.float32),
-        metric="cosine" if canonical == "cosine" else "sqeuclidean",
+        metric=canonical if canonical in ("cosine", "inner_product") else "sqeuclidean",
         res=res,
     )
     old_n = index.size
@@ -204,14 +194,12 @@ def extend(
         new_indices = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
 
     # merge with existing content host-side, then re-pack
-    old_valid = np.asarray(index.list_index) >= 0
-    old_rows = np.asarray(index.list_data)[old_valid]
-    old_ids = np.asarray(index.list_index)[old_valid]
-    old_labels = np.repeat(np.arange(index.n_lists), np.asarray(old_valid.sum(1)))
-
+    old_rows, old_ids, old_labels = unpack_lists(
+        np.asarray(index.list_data), np.asarray(index.list_index)
+    )
     all_rows = np.concatenate([old_rows, np.asarray(new_vectors)])
     all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
-    all_labels = np.concatenate([old_labels.astype(np.int32), np.asarray(labels)])
+    all_labels = np.concatenate([old_labels, np.asarray(labels)])
     list_data, list_index, list_sizes, list_norms = _pack_lists(
         all_rows, all_ids, all_labels, index.n_lists, index.metric
     )
@@ -235,18 +223,8 @@ def _search_jit(
     cap = list_data.shape[1]
     select_min = metric != "inner_product"
 
-    # ---- coarse: select n_probes lists (ref: ivf_flat_search-inl.cuh:40,
-    # GEMM + select_k — same shape here)
-    if metric == "cosine":
-        qn = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
-        cn = centers / jnp.maximum(jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
-        coarse = -jnp.matmul(qn, cn.T, precision=_PREC)
-    elif metric == "inner_product":
-        coarse = -jnp.matmul(queries, centers.T, precision=_PREC)
-    else:
-        cnorm = jnp.sum(centers * centers, axis=1)
-        coarse = cnorm[None, :] - 2.0 * jnp.matmul(queries, centers.T, precision=_PREC)
-    _, probes = select_k(coarse, n_probes, select_min=True)  # [q, p]
+    # ---- coarse: select n_probes lists (ref: ivf_flat_search-inl.cuh:40)
+    probes = coarse_select(queries, centers, metric, n_probes)  # [q, p]
 
     n_tiles = (q + query_tile - 1) // query_tile
     pad_q = n_tiles * query_tile - q
@@ -268,13 +246,10 @@ def _search_jit(
             dist = 1.0 - ip / (qn[:, None, None] * vn)
         else:  # sqeuclidean/euclidean: ‖y‖² − 2x·y (+‖x‖² later, rank-stable)
             dist = norms - 2.0 * ip
-        invalid = ids < 0
-        if filter_words is not None:
-            word = filter_words[jnp.clip(ids, 0, None) // 32]
-            bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
-            invalid = invalid | (bit == 0)
-        worst = jnp.inf
-        dist = jnp.where(invalid, worst, dist)
+        invalid = invalid_mask(ids, filter_words)
+        dist = jnp.where(invalid, jnp.inf, dist)
+        # filtered-out candidates must surface as id −1, never their real id
+        ids = jnp.where(invalid, -1, ids)
         flat_d = dist.reshape(query_tile, n_probes * cap)
         flat_i = ids.reshape(query_tile, n_probes * cap)
         v, i = select_k(flat_d, k, select_min=True, input_indices=flat_i)

@@ -1,0 +1,109 @@
+"""Refine: re-rank ANN candidates with exact distances.
+
+Reference: ``neighbors/refine.cuh`` — takes a dataset, queries, and candidate
+neighbor ids (typically from ivf_pq::search with k' > k), recomputes exact
+distances for each (query, candidate) pair, and selects the top-k
+(device impl ``detail/refine_device.cuh``; host/OpenMP impl
+``detail/refine_host-inl.hpp``; used by CAGRA build
+``detail/cagra/cagra_build.cuh:146-196``).
+
+TPU shape: candidates are a static [q, k'] id matrix → one batched gather of
+candidate vectors + a batched row-vs-row distance (VPU/MXU) + select_k.
+There is no irregularity, so this is pure XLA. A ``host=True`` path mirrors
+the reference's CPU refine (numpy, useful to overlap with device work).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
+from raft_tpu.ops.matrix import select_k
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_jit(dataset, queries, candidates, k: int, metric: str):
+    q, kprime = candidates.shape
+    safe = jnp.clip(candidates, 0, dataset.shape[0] - 1)
+    cand = dataset[safe].astype(jnp.float32)          # [q, k', d] gather
+    qf = queries.astype(jnp.float32)
+    ip = jnp.einsum("qd,qcd->qc", qf, cand, precision=_PREC)
+    if metric == "inner_product":
+        dist = -ip
+    elif metric == "cosine":
+        qn = jnp.maximum(jnp.linalg.norm(qf, axis=1), 1e-12)
+        cn = jnp.maximum(jnp.linalg.norm(cand, axis=2), 1e-12)
+        dist = 1.0 - ip / (qn[:, None] * cn)
+    else:
+        c2 = jnp.sum(cand * cand, axis=2)
+        q2 = jnp.sum(qf * qf, axis=1)
+        dist = jnp.maximum(q2[:, None] + c2 - 2.0 * ip, 0.0)
+    dist = jnp.where(candidates < 0, jnp.inf, dist)
+    v, i = select_k(dist, k, select_min=True, input_indices=candidates)
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
+def refine(
+    dataset: jax.Array,
+    queries: jax.Array,
+    candidates: jax.Array,
+    k: int,
+    *,
+    metric: str = "sqeuclidean",
+    host: bool = False,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact re-rank of ``candidates`` [q, k'] → top-k (distances, indices).
+
+    Negative candidate ids are treated as invalid (distance +inf), matching
+    the reference's handling of underfull candidate lists.
+    """
+    res = ensure(res)
+    canonical = DISTANCE_TYPES[metric]
+    candidates = jnp.asarray(candidates, jnp.int32)
+    if k > candidates.shape[1]:
+        raise ValueError(f"k={k} > candidate count {candidates.shape[1]}")
+    if host:
+        return _refine_host(
+            np.asarray(dataset), np.asarray(queries), np.asarray(candidates), k, canonical
+        )
+    return _refine_jit(jnp.asarray(dataset), jnp.asarray(queries), candidates, int(k), canonical)
+
+
+def _refine_host(dataset, queries, candidates, k, metric):
+    """CPU refine (ref: detail/refine_host-inl.hpp — OpenMP loop over
+    queries; here vectorized numpy, released-GIL BLAS)."""
+    safe = np.clip(candidates, 0, dataset.shape[0] - 1)
+    cand = dataset[safe].astype(np.float32)
+    qf = queries.astype(np.float32)
+    ip = np.einsum("qd,qcd->qc", qf, cand)
+    if metric == "inner_product":
+        dist = -ip
+    elif metric == "cosine":
+        qn = np.maximum(np.linalg.norm(qf, axis=1), 1e-12)
+        cn = np.maximum(np.linalg.norm(cand, axis=2), 1e-12)
+        dist = 1.0 - ip / (qn[:, None] * cn)
+    else:
+        c2 = np.sum(cand * cand, axis=2)
+        q2 = np.sum(qf * qf, axis=1)
+        dist = np.maximum(q2[:, None] + c2 - 2.0 * ip, 0.0)
+    dist = np.where(candidates < 0, np.inf, dist)
+    order = np.argsort(dist, axis=1, kind="stable")[:, :k]
+    v = np.take_along_axis(dist, order, axis=1)
+    i = np.take_along_axis(candidates, order, axis=1)
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
+        v = np.sqrt(np.maximum(v, 0.0))
+    return jnp.asarray(v), jnp.asarray(i)

@@ -1,0 +1,161 @@
+"""IVF-PQ: recall gates vs brute force + refine re-ranking
+(mirrors cpp/test/neighbors/ann_ivf_pq recall thresholds +
+pylibraft test_ivf_pq)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_pq, refine
+from raft_tpu.random import make_blobs
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    key = jax.random.PRNGKey(0)
+    x, _, _ = make_blobs(key, 8000, 64, n_clusters=25, cluster_std=2.0)
+    q = jax.random.normal(jax.random.PRNGKey(1), (64, 64)) * 4.0
+    return np.asarray(x), np.asarray(q)
+
+
+@pytest.fixture(scope="module")
+def built(data):
+    x, _ = data
+    params = ivf_pq.IndexParams(
+        n_lists=50, kmeans_n_iters=10, pq_dim=32, pq_bits=8, seed=0
+    )
+    return ivf_pq.build(params, x)
+
+
+def test_build_properties(built, data):
+    x, _ = data
+    assert built.n_lists == 50
+    assert built.size == x.shape[0]
+    assert built.pq_dim == 32
+    assert built.pq_len == 2
+    assert built.rot_dim == 64
+    ids = np.asarray(built.list_index)
+    np.testing.assert_array_equal(np.sort(ids[ids >= 0]), np.arange(x.shape[0]))
+    # rotation orthonormal
+    r = np.asarray(built.rotation)
+    np.testing.assert_allclose(r @ r.T, np.eye(built.rot_dim), atol=1e-4)
+
+
+@pytest.mark.parametrize("n_probes,min_recall", [(10, 0.7), (50, 0.8)])
+def test_recall_vs_bruteforce(built, data, n_probes, min_recall):
+    x, q = data
+    k = 10
+    _, gt = brute_force.knn(x, q, k)
+    _, idx = ivf_pq.search(ivf_pq.SearchParams(n_probes=n_probes), built, q, k)
+    r = float(neighborhood_recall(np.asarray(idx), np.asarray(gt)))
+    assert r >= min_recall, (n_probes, r)
+
+
+def test_refine_improves_recall(built, data):
+    x, q = data
+    k = 10
+    _, gt = brute_force.knn(x, q, k)
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=50), built, q, 4 * k)
+    _, idx = refine(x, q, cand, k)
+    r = float(neighborhood_recall(np.asarray(idx), np.asarray(gt)))
+    assert r >= 0.97, r
+    # host refine path agrees
+    _, idx_h = refine(x, q, cand, k, host=True)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(idx_h))
+
+
+def test_per_cluster_codebook(data):
+    x, q = data
+    params = ivf_pq.IndexParams(
+        n_lists=20,
+        kmeans_n_iters=8,
+        pq_dim=16,
+        pq_bits=8,
+        codebook_kind=ivf_pq.CODEBOOK_PER_CLUSTER,
+    )
+    index = ivf_pq.build(params, x)
+    _, gt = brute_force.knn(x, q, 10)
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=20), index, q, 100)
+    _, idx = refine(x, q, cand, 10)
+    r = float(neighborhood_recall(np.asarray(idx), np.asarray(gt)))
+    assert r >= 0.9, r
+
+
+def test_inner_product_metric(data):
+    x, q = data
+    params = ivf_pq.IndexParams(
+        n_lists=20, kmeans_n_iters=8, pq_dim=32, metric="inner_product"
+    )
+    index = ivf_pq.build(params, x)
+    _, gt = brute_force.knn(x, q, 10, metric="inner_product")
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=20), index, q, 40)
+    _, idx = refine(x, q, cand, 10, metric="inner_product")
+    r = float(neighborhood_recall(np.asarray(idx), np.asarray(gt)))
+    assert r >= 0.9, r
+
+
+def test_extend(data):
+    x, q = data
+    params = ivf_pq.IndexParams(
+        n_lists=20, kmeans_n_iters=5, pq_dim=16, add_data_on_build=False
+    )
+    index = ivf_pq.build(params, x)
+    assert index.size == 0
+    index = ivf_pq.extend(index, x[:5000], np.arange(5000, dtype=np.int32))
+    index = ivf_pq.extend(index, x[5000:], np.arange(5000, x.shape[0], dtype=np.int32))
+    assert index.size == x.shape[0]
+    _, gt = brute_force.knn(x, q, 10)
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=20), index, q, 100)
+    _, idx = refine(x, q, cand, 10)
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.9
+
+
+def test_bitset_prefilter(built, data):
+    x, q = data
+    n = x.shape[0]
+    mask = np.arange(n) % 2 == 1
+    bs = Bitset.from_mask(jnp.asarray(mask))
+    _, idx = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=50), built, q, 10, sample_filter=bs
+    )
+    idx = np.asarray(idx)
+    assert (idx >= 0).all()  # plenty of odd ids available — no underfill
+    assert (idx[idx >= 0] % 2 == 1).all()
+
+
+def test_save_load_roundtrip(built, data, tmp_path):
+    _, q = data
+    fn = str(tmp_path / "ivfpq.idx")
+    ivf_pq.save(fn, built)
+    loaded = ivf_pq.load(fn)
+    assert loaded.pq_bits == built.pq_bits
+    np.testing.assert_array_equal(
+        np.asarray(loaded.list_codes), np.asarray(built.list_codes)
+    )
+    d1, i1 = ivf_pq.search(ivf_pq.SearchParams(n_probes=10), built, q, 5)
+    d2, i2 = ivf_pq.search(ivf_pq.SearchParams(n_probes=10), loaded, q, 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_pq_bits_packing_roundtrip():
+    rng = np.random.default_rng(0)
+    for bits in (4, 5, 6, 7, 8):
+        codes = rng.integers(0, 1 << bits, size=(100, 24), dtype=np.uint8)
+        packed = ivf_pq._pack_bits(codes, bits)
+        assert packed.shape[1] == (24 * bits + 7) // 8
+        out = ivf_pq._unpack_bits(packed, 24, bits)
+        np.testing.assert_array_equal(out, codes)
+
+
+def test_lut_bf16(built, data):
+    """bfloat16 LUT (ref lut_dtype fp8/half analog) keeps recall."""
+    x, q = data
+    _, gt = brute_force.knn(x, q, 10)
+    _, idx = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=50, lut_dtype="bfloat16"), built, q, 10
+    )
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.75

@@ -72,3 +72,68 @@ def test_sharded_knn_inner_product():
     dv, di = sharded_knn(comms, x, q, 5, metric="inner_product")
     sv, si = brute_force.knn(x, q, 5, metric="inner_product")
     assert float(neighborhood_recall(np.asarray(di), np.asarray(si))) >= 0.999
+
+
+def test_ivf_filtered_ids_never_leak():
+    """A sparse bitset that leaves fewer than k candidates must yield -1 ids
+    with +inf distance, never the real id of a filtered-out vector
+    (code-review finding: filtered candidates kept real ids)."""
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.neighbors import ivf_flat
+
+    rng = np.random.default_rng(0)
+    x = rng.random((500, 16)).astype(np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=4), x)
+    mask = np.zeros(500, bool)
+    mask[:5] = True  # only 5 allowed ids, k=10
+    bs = Bitset.from_mask(jnp.asarray(mask))
+    d, i = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=4), index, x[:8], 10, sample_filter=bs
+    )
+    d, i = np.asarray(d), np.asarray(i)
+    assert set(i[i >= 0].ravel()) <= set(range(5))
+    assert np.isinf(d[i < 0]).all()
+
+
+def test_kmeans_cosine_metric_respected():
+    """KMeansParams.metric='cosine' runs spherical kmeans (code-review
+    finding: metric field was silently ignored)."""
+    from raft_tpu.cluster import kmeans
+
+    rng = np.random.default_rng(0)
+    # two directions, different magnitudes — cosine sees 2 clusters
+    a = rng.normal(0, 0.01, (50, 8)).astype(np.float32) + np.eye(8)[0] * 1.0
+    b = rng.normal(0, 0.01, (50, 8)).astype(np.float32) + np.eye(8)[1] * 1.0
+    x = np.concatenate([a * rng.uniform(0.5, 5.0, (50, 1)), b * rng.uniform(0.5, 5.0, (50, 1))])
+    params = kmeans.KMeansParams(n_clusters=2, metric="cosine", seed=0)
+    c, inertia, _ = kmeans.fit(params, x)
+    labels = np.asarray(kmeans.predict(c, x, metric="cosine"))
+    assert len(set(labels[:50])) == 1 and len(set(labels[50:])) == 1
+    assert labels[0] != labels[-1]
+    # centers on unit sphere
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(c), axis=1), 1.0, atol=1e-4)
+
+
+def test_kmeans_init_array_validation():
+    from raft_tpu.cluster import kmeans
+
+    with np.testing.assert_raises(ValueError):
+        kmeans.fit(kmeans.KMeansParams(n_clusters=2, init="array"), np.ones((10, 3)))
+
+
+def test_kmeans_balanced_hierarchical_empty_meso():
+    """Hierarchical fit must not crash when mesoclusters end up empty
+    (code-review finding: AssertionError on empty mesocluster)."""
+    from raft_tpu.cluster import kmeans_balanced
+
+    rng = np.random.default_rng(0)
+    # tiny tight blob + enough rows to trigger the hierarchical path
+    x = np.concatenate(
+        [rng.normal(0, 0.001, (2000, 4)), rng.normal(100, 0.001, (2000, 4))]
+    ).astype(np.float32)
+    params = kmeans_balanced.KMeansBalancedParams(
+        n_iters=4, mesocluster_threshold=8, seed=0
+    )
+    centers = kmeans_balanced.fit(params, x, 300)
+    assert centers.shape == (300, 4)
+    assert np.isfinite(np.asarray(centers)).all()
