@@ -143,9 +143,11 @@ def _fit_flat(
 ) -> jax.Array:
     k_init, k_iter = jax.random.split(key)
     n = x.shape[0]
-    # init ∝ weight so weight-0 padding rows are never seeds
-    idx = jax.random.categorical(
-        k_init, jnp.where(weights > 0, 0.0, -jnp.inf), shape=(n_clusters,)
+    # init ∝ weight, *without replacement*: distinct seeds, and weight-0
+    # padding rows are never chosen while any positive-weight row remains
+    idx = jax.random.choice(
+        k_init, n, shape=(n_clusters,), replace=n < n_clusters,
+        p=weights / jnp.maximum(jnp.sum(weights), 1e-12),
     )
     centers0 = x[idx]
     centers, _ = _balanced_iterations(

@@ -1,6 +1,6 @@
 """Nearest-neighbor indexes (ref: cpp/include/raft/neighbors/)."""
 
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, nn_descent
 from raft_tpu.neighbors.refine import refine
 
-__all__ = ["brute_force", "ivf_flat", "ivf_pq", "refine"]
+__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "nn_descent", "refine"]

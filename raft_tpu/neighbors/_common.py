@@ -70,6 +70,23 @@ def coarse_select(
     return probes
 
 
+def sorted_id_dedup(ids: jax.Array):
+    """Shared sorted-id dedup idiom: stable-sort each row by id and flag every
+    repeat after the first occurrence (the TPU replacement for visited
+    hash-sets / bloom filters — one sort + one adjacent compare).
+
+    Returns (order [n, m] int32 — the stable argsort, dup [n, m] bool in
+    *sorted* space). Callers gather their payloads through ``order`` and
+    demote slots where ``dup`` (first occurrence in the original layout wins,
+    because stable sort preserves it)."""
+    order = jnp.argsort(ids, axis=-1, stable=True)
+    s = jnp.take_along_axis(ids, order, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros_like(s[..., :1], bool), s[..., 1:] == s[..., :-1]], axis=-1
+    )
+    return order, dup
+
+
 def invalid_mask(ids: jax.Array, filter_words: Optional[jax.Array]) -> jax.Array:
     """Candidate mask: padding slots plus bitset-filtered ids
     (ref: neighbors/sample_filter_types.hpp bitset_filter)."""

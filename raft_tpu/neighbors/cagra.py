@@ -1,0 +1,498 @@
+"""CAGRA: graph-based ANN index (build + batched beam search).
+
+Reference surface: ``neighbors/cagra.cuh`` / ``cagra_types.hpp:57-142`` —
+build = kNN graph via IVF-PQ-search+refine or NN-descent
+(``graph_build_algo`` cagra_types.hpp:50-63), then ``sort_knn_graph`` +
+2-hop detour-counting ``optimize``/prune (detail/cagra/graph_core.cuh:
+130-235,322); search = beam search with a visited filter and per-query
+persistent CTA kernels (detail/cagra/search_single_cta_kernel-inl.cuh:55-592,
+search_multi_kernel.cuh; plan/tuning search_plan.cuh:81-164 — ``itopk_size``,
+``search_width``, hashmap sizing; Python ref: pylibraft neighbors/cagra).
+
+TPU re-design
+-------------
+* **Build** is batched dense ops end to end: the kNN graph comes from
+  IVF-PQ search + exact refine (cagra_build.cuh:47-201), NN-descent
+  (our static-shape formulation, nn_descent.py), or exact brute force for
+  small sets. ``optimize`` — the detour-count prune — is a per-row
+  [K, K, K] membership tensor contraction, tiled with ``lax.scan``; the
+  reverse-edge pass is one sort-based scatter. No irregularity anywhere.
+* **Search** replaces the per-query persistent CTA + hash-set with a
+  *query-batched* beam search: state is a static [tile, itopk] candidate
+  buffer with explored flags; one iteration = select_k unexplored parents
+  (search_width), one gather of graph rows, one MXU distance batch, and a
+  sorted-id dedup merge back into the buffer (the dedup plays the role of
+  the reference's visited hashmap, detail/cagra/hashmap.hpp). The whole
+  search is one ``lax.while_loop`` inside jit — SURVEY §7 strategy (a).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
+from raft_tpu.neighbors import brute_force, ivf_pq, nn_descent
+from raft_tpu.neighbors._common import sorted_id_dedup
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.ops.matrix import select_k
+
+_SERIALIZATION_VERSION = 1
+
+
+@dataclass
+class IndexParams:
+    """(ref: cagra_types.hpp:57-121 index_params)"""
+
+    metric: str = "sqeuclidean"
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    build_algo: str = "auto"       # auto | ivf_pq | nn_descent | brute_force
+    nn_descent_niter: int = 20
+    seed: int = 0
+
+
+@dataclass
+class SearchParams:
+    """(ref: cagra_types.hpp search_params / search_plan.cuh:81-164)"""
+
+    max_queries: int = 0          # 0 → auto query tile
+    itopk_size: int = 64
+    max_iterations: int = 0       # 0 → auto
+    search_width: int = 4
+    min_iterations: int = 0
+    rand_xor_mask: int = 0x128394  # seed for random init candidates
+    num_random_samplings: int = 1
+
+
+class Index:
+    """CAGRA index: dataset + fixed-degree directed graph
+    (ref: cagra_types.hpp:142 index{dataset, graph})."""
+
+    def __init__(self, metric: str, dataset: jax.Array, graph: jax.Array):
+        self.metric = metric
+        self.dataset = dataset
+        self.graph = graph
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+
+# --------------------------------------------------------------------------
+# graph optimization (ref: detail/cagra/graph_core.cuh)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("out_degree", "tile"))
+def _prune_detourable(graph: jax.Array, out_degree: int, tile: int) -> jax.Array:
+    """Detour-count prune (ref: graph_core.cuh kern_prune:130-187).
+
+    Edge (u → v=g[u,j]) is detourable through w=g[u,i] (i<j, so w is closer
+    to u than v) when v also appears in w's neighbor list. Edges are ranked
+    by (detour_count, original rank) and the best ``out_degree`` kept.
+    """
+    n, K = graph.shape
+
+    def body(_, row0):
+        rows = jnp.clip(row0 + jnp.arange(tile), 0, n - 1)
+        g = graph[rows]                                   # [t, K]
+        safe = jnp.clip(g, 0, n - 1)
+        hop2 = graph[safe]                                # [t, K(i), K(l)]
+        # match[t,i,j] = g[t,j] ∈ hop2[t,i,:]
+        match = jnp.any(
+            hop2[:, :, :, None] == g[:, None, None, :], axis=2
+        )                                                 # [t, i, j]
+        lower = jnp.tril(jnp.ones((K, K), bool), k=-1)    # i < j mask (i rows)
+        detour = jnp.sum(match & lower.T[None], axis=1)   # [t, j]
+        detour = jnp.where(g < 0, K + 1, detour)
+        # lexicographic (detour, rank): stable sort by detour keeps rank order
+        order = jnp.argsort(detour, axis=1, stable=True)
+        kept = jnp.take_along_axis(g, order[:, :out_degree], axis=1)
+        return _, (rows, kept)
+
+    n_tiles = (n + tile - 1) // tile
+    starts = jnp.arange(n_tiles) * tile
+    _, (rows, kept) = lax.scan(body, None, starts)
+    out = jnp.zeros((n, out_degree), jnp.int32)
+    return out.at[rows.reshape(-1)].set(kept.reshape(-1, out_degree))
+
+
+@functools.partial(jax.jit, static_argnames=("rev_cap",))
+def _reverse_graph(graph: jax.Array, rev_cap: int) -> jax.Array:
+    """Reverse-edge lists via one sort-based scatter
+    (ref: graph_core.cuh optimize reverse pass :322)."""
+    n, D = graph.shape
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, D)).ravel()
+    tgt = graph.ravel()
+    order = jnp.argsort(tgt, stable=True)
+    tgt_s, src_s = tgt[order], src[order]
+    # position within each target group = index − first index of the group
+    first = jnp.searchsorted(tgt_s, tgt_s, side="left")
+    pos = jnp.arange(n * D) - first
+    valid = (tgt_s >= 0) & (pos < rev_cap)
+    rev = jnp.full((n, rev_cap), -1, jnp.int32)
+    rev = rev.at[jnp.where(valid, tgt_s, n), jnp.where(valid, pos, 0)].set(
+        jnp.where(valid, src_s, -1), mode="drop"
+    )
+    return rev
+
+
+@jax.jit
+def _merge_forward_reverse(forward: jax.Array, reverse: jax.Array) -> jax.Array:
+    """Final edge list: protect the best forward half, then prefer reverse
+    edges over weak forward edges, order-preserving dedupe
+    (ref: graph_core.cuh optimize merge, num_protected_edges = degree/2)."""
+    n, D = forward.shape
+    prot = (D + 1) // 2
+    cand = jnp.concatenate([forward[:, :prot], reverse, forward[:, prot:]], axis=1)
+    m = cand.shape[1]
+    # first-occurrence flags, mapped back to the original (unsorted) layout
+    order, dup_s = sorted_id_dedup(cand)
+    dup = jnp.zeros((n, m), bool).at[
+        jnp.arange(n)[:, None], order
+    ].set(dup_s)
+    bad = dup | (cand < 0)
+    # stable order with dups pushed past the end
+    prio = jnp.where(bad, m + jnp.arange(m)[None, :], jnp.arange(m)[None, :])
+    keep = jnp.argsort(prio, axis=1, stable=True)[:, :D]
+    out = jnp.take_along_axis(cand, keep, axis=1)
+    # rows with < D unique candidates: backfill from forward (always unique)
+    out = jnp.where(out < 0, forward, out)
+    return out
+
+
+def optimize(
+    knn_graph: jax.Array,
+    out_degree: int,
+    *,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Prune an intermediate kNN graph (rows sorted by distance) to a
+    fixed-degree CAGRA search graph (ref: graph_core.cuh optimize)."""
+    res = ensure(res)
+    knn_graph = jnp.asarray(knn_graph, jnp.int32)
+    n, K = knn_graph.shape
+    if out_degree > K:
+        raise ValueError(f"out_degree {out_degree} > input degree {K}")
+    # [t, K, K, K] bool membership tensor bounds the tile
+    tile = max(1, min(n, res.workspace_rows(K * K * K, cap=256)))
+    pruned = _prune_detourable(knn_graph, out_degree, tile)
+    rev = _reverse_graph(pruned, out_degree)
+    return _merge_forward_reverse(pruned, rev)
+
+
+# --------------------------------------------------------------------------
+# build (ref: detail/cagra/cagra_build.cuh)
+# --------------------------------------------------------------------------
+
+def build(
+    params: IndexParams,
+    dataset: jax.Array,
+    *,
+    res: Optional[Resources] = None,
+) -> Index:
+    """(ref: cagra_build.cuh build: build_knn_graph → sort → optimize)"""
+    res = ensure(res)
+    dataset = jnp.asarray(dataset, jnp.float32)
+    n, d = dataset.shape
+    metric = DISTANCE_TYPES[params.metric]
+    if metric not in ("sqeuclidean", "euclidean", "inner_product"):
+        raise ValueError(f"cagra supports L2/IP metrics, got {params.metric}")
+    inter = min(params.intermediate_graph_degree, n - 1)
+    degree = min(params.graph_degree, inter)
+
+    algo = params.build_algo
+    if algo == "auto":
+        algo = "brute_force" if n <= 8192 else "ivf_pq"
+
+    if algo == "brute_force":
+        g = nn_descent.build_exact(dataset, inter, metric=params.metric, res=res)
+        knn_graph = g.graph
+    elif algo == "nn_descent":
+        nnd = nn_descent.IndexParams(
+            graph_degree=inter,
+            intermediate_graph_degree=min(n - 1, max(inter + inter // 2, inter + 8)),
+            max_iterations=params.nn_descent_niter,
+            metric=params.metric,
+            seed=params.seed,
+        )
+        knn_graph = nn_descent.build(nnd, dataset, res=res).graph
+    elif algo == "ivf_pq":
+        # ref cagra_build.cuh:47-201: ivf_pq build → per-row search with
+        # gpu_top_k = degree * refine_rate → exact refine → drop self
+        ip = ivf_pq.IndexParams(
+            n_lists=max(4, min(1024, n // 1000 or 4)),
+            metric=params.metric,
+            kmeans_trainset_fraction=min(1.0, 10000.0 * max(4, n // 1000) / n)
+            if n > 0 else 1.0,
+            seed=params.seed,
+        )
+        idx = ivf_pq.build(ip, dataset, res=res)
+        sp = ivf_pq.SearchParams(n_probes=max(8, min(idx.n_lists, 32)))
+        gpu_top_k = min(n, 2 * (inter + 1))
+        cand_parts = []
+        qtile = max(1, res.workspace_rows(4 * n // 64 + 4 * d, cap=8192))
+        for s in range(0, n, qtile):
+            _, ids = ivf_pq.search(sp, idx, dataset[s : s + qtile], gpu_top_k, res=res)
+            cand_parts.append(ids)
+        cands = jnp.concatenate(cand_parts)
+        _, knn_graph = refine(
+            dataset, dataset, cands, inter + 1, metric=params.metric, res=res
+        )
+        # drop the self column wherever it landed
+        self_col = knn_graph == jnp.arange(n, dtype=knn_graph.dtype)[:, None]
+        order = jnp.argsort(self_col, axis=1, stable=True)
+        knn_graph = jnp.take_along_axis(knn_graph, order, axis=1)[:, :inter]
+    else:
+        raise ValueError(f"unknown build_algo {params.build_algo}")
+
+    graph = optimize(knn_graph, degree, res=res)
+    return Index(params.metric, dataset, graph)
+
+
+def from_graph(metric: str, dataset: jax.Array, graph: jax.Array) -> Index:
+    """Construct an index from a prebuilt graph (ref: cagra index ctor from
+    existing dataset+graph mdspans, cagra_types.hpp:142)."""
+    return Index(metric, jnp.asarray(dataset, jnp.float32), jnp.asarray(graph, jnp.int32))
+
+
+# --------------------------------------------------------------------------
+# search (ref: detail/cagra/search_single_cta_kernel-inl.cuh, TPU-batched)
+# --------------------------------------------------------------------------
+
+def _query_distance(qs: jax.Array, vecs: jax.Array, metric: str) -> jax.Array:
+    """dist(qs[i], vecs[i, j]) — [t, d] vs [t, c, d]."""
+    ip = jnp.einsum("td,tcd->tc", qs, vecs, precision=_PREC)
+    if metric == "inner_product":
+        return -ip
+    v2 = jnp.sum(vecs * vecs, axis=2)
+    q2 = jnp.sum(qs * qs, axis=1)
+    return jnp.maximum(q2[:, None] + v2 - 2.0 * ip, 0.0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "metric", "tile"),
+)
+def _search_jit(
+    dataset, graph, queries, filter_words, seed_ids,
+    k: int, itopk: int, width: int, max_iter: int, min_iter: int,
+    metric: str, tile: int,
+):
+    n, d = dataset.shape
+    deg = graph.shape[1]
+    q = queries.shape[0]
+    n_tiles = (q + tile - 1) // tile
+    pad = n_tiles * tile - q
+    qt = jnp.pad(queries, ((0, pad), (0, 0))).reshape(n_tiles, tile, d)
+    st = jnp.pad(seed_ids, ((0, pad), (0, 0))).reshape(n_tiles, tile, -1)
+
+    def filt_inf(ids, dists):
+        if filter_words is None:
+            return dists
+        word = filter_words[jnp.clip(ids, 0, None) // 32]
+        bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
+        return jnp.where(bit == 0, jnp.inf, dists)
+
+    def one_tile(args):
+        qs, seeds = args                                  # [t, d], [t, s]
+        # ---- random init (ref: random_samplings init of itopk candidates)
+        vecs = dataset[jnp.clip(seeds, 0, n - 1)]
+        dists = _query_distance(qs, vecs, metric)
+        dists = jnp.where(seeds < 0, jnp.inf, dists)
+        # dedupe seeds, take itopk best
+        order, dup = sorted_id_dedup(seeds)
+        s_ids = jnp.take_along_axis(seeds, order, axis=1)
+        s_d = jnp.where(dup, jnp.inf, jnp.take_along_axis(dists, order, axis=1))
+        buf_d, buf_i = select_k(s_d, itopk, select_min=True, input_indices=s_ids)
+        # inf slots must not retain a real id: it would shadow (dedup-demote)
+        # a later finite copy of the same node forever
+        buf_i = jnp.where(jnp.isfinite(buf_d), buf_i, -1)
+        explored = jnp.zeros((tile, itopk), bool)
+        # result buffer: best-k *filter-passing* candidates seen so far.
+        # Traversal itself stays unfiltered — filtered-out nodes still route
+        # the walk (ref: CAGRA filtering excludes hits from the result list,
+        # not from graph navigation).
+        res_d, res_i = select_k(
+            filt_inf(buf_i, buf_d), k, select_min=True, input_indices=buf_i
+        )
+        res_i = jnp.where(jnp.isfinite(res_d), res_i, -1)
+
+        def cond(state):
+            it, buf_i, buf_d, explored, res_i, res_d = state
+            frontier = ~explored & jnp.isfinite(buf_d)
+            return (it < min_iter) | ((it < max_iter) & jnp.any(frontier))
+
+        def body(state):
+            it, buf_i, buf_d, explored, res_i, res_d = state
+            # ---- pick search_width best unexplored parents
+            # (ref: pickup_next_parents search_single_cta_kernel-inl.cuh:55)
+            front_d = jnp.where(explored | ~jnp.isfinite(buf_d), jnp.inf, buf_d)
+            _, ppos = select_k(front_d, width, select_min=True)
+            parent_ok = jnp.take_along_axis(front_d, ppos, axis=1) < jnp.inf
+            parents = jnp.take_along_axis(buf_i, ppos, axis=1)    # [t, w]
+            explored = explored.at[
+                jnp.arange(tile)[:, None], ppos
+            ].set(True)
+            # ---- expand: gather graph rows (the data-dependent gather)
+            nbrs = graph[jnp.clip(parents, 0, n - 1)]             # [t, w, deg]
+            nbrs = jnp.where(parent_ok[:, :, None], nbrs, -1)
+            cand = nbrs.reshape(tile, width * deg)
+            vecs = dataset[jnp.clip(cand, 0, n - 1)]              # [t, w*deg, d]
+            cd = _query_distance(qs, vecs, metric)
+            cd = jnp.where(cand < 0, jnp.inf, cd)
+            # ---- fold filter-passing candidates into the result buffer.
+            # The same node is offered as a candidate by many parents across
+            # iterations, so the merge must dedup by id or the buffer fills
+            # with copies of the single best allowed hit.
+            if filter_words is not None:
+                m_i = jnp.concatenate([res_i, cand], axis=1)
+                m_d = jnp.concatenate([res_d, filt_inf(cand, cd)], axis=1)
+                order, dup = sorted_id_dedup(m_i)
+                ms_i = jnp.take_along_axis(m_i, order, axis=1)
+                ms_d = jnp.take_along_axis(m_d, order, axis=1)
+                ms_d = jnp.where(dup | (ms_i < 0), jnp.inf, ms_d)
+                res_d, res_i = select_k(
+                    ms_d, k, select_min=True, input_indices=ms_i
+                )
+                res_i = jnp.where(jnp.isfinite(res_d), res_i, -1)
+            # ---- merge + dedup (plays the visited-hashmap role)
+            all_i = jnp.concatenate([buf_i, cand], axis=1)
+            all_d = jnp.concatenate([buf_d, cd], axis=1)
+            all_e = jnp.concatenate(
+                [explored, jnp.zeros((tile, width * deg), bool)], axis=1
+            )
+            order, dup = sorted_id_dedup(all_i)
+            s_i = jnp.take_along_axis(all_i, order, axis=1)
+            s_d = jnp.take_along_axis(all_d, order, axis=1)
+            s_e = jnp.take_along_axis(all_e, order, axis=1)
+            # a dup's first (stable) copy is the old buffer entry → keeps its
+            # explored flag; later copies are demoted
+            s_d = jnp.where(dup | (s_i < 0), jnp.inf, s_d)
+            buf_d, pos = select_k(s_d, itopk, select_min=True)
+            buf_i = jnp.take_along_axis(s_i, pos, axis=1)
+            buf_i = jnp.where(jnp.isfinite(buf_d), buf_i, -1)
+            explored = jnp.take_along_axis(s_e, pos, axis=1)
+            explored = explored | ~jnp.isfinite(buf_d)
+            return it + 1, buf_i, buf_d, explored, res_i, res_d
+
+        _, buf_i, buf_d, _, res_i, res_d = lax.while_loop(
+            cond, body,
+            (jnp.zeros((), jnp.int32), buf_i, buf_d, explored, res_i, res_d),
+        )
+        if filter_words is None:
+            v, i = select_k(buf_d, k, select_min=True, input_indices=buf_i)
+        else:
+            # result buffer may hold duplicate ids past the frontier (see
+            # body); one final dedup pass cleans them
+            order, dup = sorted_id_dedup(res_i)
+            s_i = jnp.take_along_axis(res_i, order, axis=1)
+            s_d = jnp.where(dup, jnp.inf, jnp.take_along_axis(res_d, order, axis=1))
+            v, i = select_k(s_d, k, select_min=True, input_indices=s_i)
+        i = jnp.where(jnp.isfinite(v), i, -1)
+        if metric == "inner_product":
+            v = -v
+        elif metric == "euclidean":
+            v = jnp.sqrt(jnp.maximum(v, 0.0))
+        return v, i
+
+    vals, idx = lax.map(one_tile, (qt, st))
+    return vals.reshape(-1, k)[:q], idx.reshape(-1, k)[:q]
+
+
+def search(
+    params: SearchParams,
+    index: Index,
+    queries: jax.Array,
+    k: int,
+    *,
+    sample_filter: Optional[Bitset] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched beam search (ref: cagra_search.cuh → single-CTA kernel,
+    re-expressed as query-batched iterations). Returns
+    (distances [q, k], indices [q, k])."""
+    res = ensure(res)
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries shape {queries.shape} vs index dim {index.dim}")
+    n = index.size
+    metric = DISTANCE_TYPES[index.metric]
+    itopk = min(max(params.itopk_size, k), n)
+    if sample_filter is not None:
+        # widen the internal buffer by the filter's inverse pass rate so the
+        # beam still meets ~itopk allowed nodes (the reference ecosystem's
+        # filtered search similarly grows its workload; heavy filters
+        # otherwise starve the result list). Rounded to a power of two to
+        # bound recompilation to O(log n) shape buckets.
+        passing = max(1, int(sample_filter.count()))
+        scale = min(32.0, max(1.0, n / passing))
+        widened = min(n, int(itopk * scale))
+        itopk = 1 << (widened - 1).bit_length()
+        itopk = min(itopk, n)
+    width = params.search_width
+    deg = index.graph_degree
+    # ref search_plan.cuh: auto max_iterations scales with itopk/width
+    max_iter = params.max_iterations or max(16, (itopk + width - 1) // width * 2)
+    min_iter = min(params.min_iterations, max_iter)
+
+    q = queries.shape[0]
+    # random init candidates (ref rand_xor_mask seeds + num_random_samplings).
+    # Scoring seeds is one cheap distance batch, and a generous pool is what
+    # makes search robust to graphs with weakly-connected clusters — so the
+    # default is larger than the reference's itopk-sized sampling.
+    n_seeds = min(n, max(2 * itopk, 128) * max(1, params.num_random_samplings))
+    key = jax.random.PRNGKey(params.rand_xor_mask & 0x7FFFFFFF)
+    seed_ids = jax.random.randint(key, (q, n_seeds), 0, n, jnp.int32)
+
+    per_q = 4 * (width * deg) * (index.dim + 4) + 16 * itopk
+    tile = params.max_queries or max(1, min(max(q, 1), res.workspace_rows(per_q, cap=512)))
+    fw = sample_filter.words if sample_filter is not None else None
+    return _search_jit(
+        index.dataset, index.graph, queries, fw, seed_ids,
+        int(k), int(itopk), int(width), int(max_iter), int(min_iter),
+        metric, int(tile),
+    )
+
+
+# --------------------------------------------------------------------------
+# serialization (ref: detail/cagra/cagra_serialize.cuh)
+# --------------------------------------------------------------------------
+
+def save(filename: str, index: Index, *, include_dataset: bool = True) -> None:
+    arrays = {"graph": index.graph}
+    if include_dataset:
+        arrays["dataset"] = index.dataset
+    ser.save_tree(
+        filename, "cagra", _SERIALIZATION_VERSION,
+        {"metric": index.metric, "include_dataset": int(include_dataset)},
+        arrays,
+    )
+
+
+def load(filename: str, *, dataset: Optional[jax.Array] = None) -> Index:
+    scalars, arrays = ser.load_tree(filename, "cagra", _SERIALIZATION_VERSION)
+    if scalars["include_dataset"]:
+        ds = jnp.asarray(arrays["dataset"])
+    elif dataset is not None:
+        ds = jnp.asarray(dataset, jnp.float32)
+    else:
+        raise ValueError("index was saved without dataset; pass dataset=")
+    return Index(scalars["metric"], ds, jnp.asarray(arrays["graph"]))

@@ -180,17 +180,25 @@ def make_rotation_matrix(
 
 
 @functools.partial(jax.jit, static_argnames=("n_centers", "n_iters"))
-def _train_codebooks_lloyd(key, subvecs, n_centers: int, n_iters: int):
+def _train_codebooks_lloyd(key, subvecs, n_centers: int, n_iters: int,
+                           weights=None):
     """Batched Lloyd over S independent subspace problems.
 
-    subvecs: [S, n, pq_len]. Returns [S, n_centers, pq_len]. vmapped so all
+    subvecs: [S, n, pq_len], weights: optional [S, n] (0 ⇒ row is padding and
+    contributes nothing). Returns [S, n_centers, pq_len]. vmapped so all
     pq_dim (or n_lists) codebooks train in one XLA program
     (ref: train_per_subset ivf_pq_build.cuh:395 / train_per_cluster :473,
     which run a kmeans per subspace on residual slices)."""
     S, n, L = subvecs.shape
+    if weights is None:
+        weights = jnp.ones((S, n), subvecs.dtype)
 
-    def one(key, x):
-        idx = jax.random.choice(key, n, shape=(n_centers,), replace=n < n_centers)
+    def one(key, x, w):
+        # weight-proportional seed draw keeps padding rows out of the init
+        idx = jax.random.choice(
+            key, n, shape=(n_centers,), replace=n < n_centers,
+            p=w / jnp.maximum(jnp.sum(w), 1e-12),
+        )
         centers0 = x[idx]
 
         def body(centers, _):
@@ -199,16 +207,16 @@ def _train_codebooks_lloyd(key, subvecs, n_centers: int, n_iters: int):
                 - 2.0 * jnp.matmul(x, centers.T, precision=_PREC)
             )
             labels = jnp.argmin(d2, axis=1)
-            sums = jax.ops.segment_sum(x, labels, num_segments=n_centers)
-            counts = jax.ops.segment_sum(jnp.ones((n,), x.dtype), labels, n_centers)
-            new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers)
+            sums = jax.ops.segment_sum(x * w[:, None], labels, num_segments=n_centers)
+            counts = jax.ops.segment_sum(w, labels, n_centers)
+            new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-12), centers)
             return new, None
 
         centers, _ = lax.scan(body, centers0, None, length=n_iters)
         return centers
 
     keys = jax.random.split(key, S)
-    return jax.vmap(one)(keys, subvecs)
+    return jax.vmap(one)(keys, subvecs, weights)
 
 
 @functools.partial(jax.jit, static_argnames=("codebook_kind",))
@@ -296,17 +304,21 @@ def build(
         codebook = _train_codebooks_lloyd(k_cb, subvecs, k_pq, 25)
     elif params.codebook_kind == CODEBOOK_PER_CLUSTER:
         # pool every subspace slice of a cluster's residuals into one training
-        # set per cluster, padded to uniform count (weight-0 via repeat-pad)
+        # set per cluster, padded to uniform count with weight-0 rows so the
+        # padding cannot bias the centroids
         sub = np.asarray(resid).reshape(-1, pq_dim, pq_len)
         lab = np.asarray(labels)
         per = [sub[lab == c].reshape(-1, pq_len) for c in range(params.n_lists)]
         cap = max(max((p.shape[0] for p in per), default=1), k_pq)
         pooled = np.zeros((params.n_lists, cap, pq_len), np.float32)
+        wts = np.zeros((params.n_lists, cap), np.float32)
         for c, p in enumerate(per):
             if p.shape[0]:
-                reps = (cap + p.shape[0] - 1) // p.shape[0]
-                pooled[c] = np.tile(p, (reps, 1))[:cap]
-        codebook = _train_codebooks_lloyd(k_cb, jnp.asarray(pooled), k_pq, 25)
+                pooled[c, : p.shape[0]] = p
+                wts[c, : p.shape[0]] = 1.0
+        codebook = _train_codebooks_lloyd(
+            k_cb, jnp.asarray(pooled), k_pq, 25, jnp.asarray(wts)
+        )
     else:
         raise ValueError(f"unknown codebook_kind {params.codebook_kind}")
 
@@ -420,24 +432,35 @@ def _search_jit(
 
     def tile(args):
         qr, qorig, pp = args  # [t, rot_dim], [t, dim], [t, p]
-        c_rot = centers_rot[pp]                         # [t, p, rot_dim]
-        # residual queries in rotated space, split into subspaces
-        res = (qr[:, None, :] - c_rot) if metric != "inner_product" else qr[:, None, :] + 0.0 * c_rot
-        res = res.reshape(query_tile, n_probes, pq_dim, pq_len)
-
         # ---- LUT (ref: compute_similarity shmem LUT; here one MXU einsum)
-        if codebook_kind == CODEBOOK_PER_SUBSPACE:
-            # cb: [j, k, l]
-            ip = jnp.einsum("tpjl,jkl->tpjk", res, codebook, precision=_PREC)
-            cb2 = jnp.sum(codebook * codebook, axis=2)[None, None]  # [1,1,j,k]
+        if metric == "inner_product" and codebook_kind == CODEBOOK_PER_SUBSPACE:
+            # probe-independent: one einsum per query, broadcast over probes
+            qsub = qr.reshape(query_tile, 1, pq_dim, pq_len)
+            ipq = jnp.einsum("tjl,jkl->tjk", qsub[:, 0], codebook, precision=_PREC)
+            lut = jnp.broadcast_to(
+                -ipq[:, None], (query_tile, n_probes, pq_dim, ipq.shape[-1])
+            )
         else:
-            cb = codebook[pp]                            # [t, p, k, l]
-            ip = jnp.einsum("tpjl,tpkl->tpjk", res, cb, precision=_PREC)
-            cb2 = jnp.sum(cb * cb, axis=3)[:, :, None, :]  # [t,p,1,k]
-        if metric == "inner_product":
-            lut = -ip                                    # score_j = −(q_j·cb_k)
-        else:
-            lut = cb2 - 2.0 * ip                         # ‖res_j−cb_k‖² − ‖res_j‖²
+            c_rot = centers_rot[pp]                      # [t, p, rot_dim]
+            # residual queries in rotated space, split into subspaces
+            res = (
+                (qr[:, None, :] - c_rot)
+                if metric != "inner_product"
+                else jnp.broadcast_to(qr[:, None, :], c_rot.shape)
+            )
+            res = res.reshape(query_tile, n_probes, pq_dim, pq_len)
+            if codebook_kind == CODEBOOK_PER_SUBSPACE:
+                # cb: [j, k, l]
+                ip = jnp.einsum("tpjl,jkl->tpjk", res, codebook, precision=_PREC)
+                cb2 = jnp.sum(codebook * codebook, axis=2)[None, None]  # [1,1,j,k]
+            else:
+                cb = codebook[pp]                        # [t, p, k, l]
+                ip = jnp.einsum("tpjl,tpkl->tpjk", res, cb, precision=_PREC)
+                cb2 = jnp.sum(cb * cb, axis=3)[:, :, None, :]  # [t,p,1,k]
+            if metric == "inner_product":
+                lut = -ip                                # score_j = −(q_j·cb_k)
+            else:
+                lut = cb2 - 2.0 * ip                     # ‖res_j−cb_k‖² − ‖res_j‖²
         lut = lut.astype(lut_dtype)
 
         # ---- scan codes: score[t,p,c] = Σ_j LUT[t,p,j,codes[p,c,j]]
