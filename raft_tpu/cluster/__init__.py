@@ -12,8 +12,11 @@ from raft_tpu.cluster.kmeans import (
     kmeans_plus_plus_init,
 )
 from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.single_linkage import SingleLinkageOutput, single_linkage
 
 __all__ = [
+    "SingleLinkageOutput",
+    "single_linkage",
     "KMeansParams",
     "fit",
     "predict",

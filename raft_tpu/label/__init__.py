@@ -1,0 +1,6 @@
+"""Label utilities (ref: cpp/include/raft/label/)."""
+
+from raft_tpu.label.classlabels import get_classlabels, make_monotonic, relabel
+from raft_tpu.label.merge_labels import merge_labels
+
+__all__ = ["get_classlabels", "make_monotonic", "relabel", "merge_labels"]

@@ -1,0 +1,37 @@
+"""Class-label utilities (ref: label/classlabels.cuh — getUniquelabels,
+getOvrlabels, make_monotonic)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_classlabels(labels: jax.Array) -> jax.Array:
+    """Sorted unique labels (ref: classlabels.cuh getUniquelabels).
+    Host-compacted (result size is data-dependent)."""
+    return jnp.asarray(np.unique(np.asarray(labels)))
+
+
+def make_monotonic(labels: jax.Array, *, classes: jax.Array = None) -> jax.Array:
+    """Map labels onto 0..k−1 preserving sorted order
+    (ref: classlabels.cuh make_monotonic)."""
+    labels = jnp.asarray(labels)
+    if classes is None:
+        classes = get_classlabels(labels)
+    else:
+        classes = jnp.asarray(classes)
+    return jnp.searchsorted(classes, labels).astype(jnp.int32)
+
+
+def relabel(labels: jax.Array, old: jax.Array, new: jax.Array) -> jax.Array:
+    """Replace each occurrence of old[i] with new[i] (ref: getOvrlabels-style
+    relabelling used by one-vs-rest pipelines)."""
+    labels = jnp.asarray(labels)
+    out = labels
+    for o, v in zip(np.asarray(old).tolist(), np.asarray(new).tolist()):
+        out = jnp.where(labels == o, v, out)
+    return out

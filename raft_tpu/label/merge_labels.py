@@ -1,0 +1,75 @@
+"""Merge two label arrays over a shared mask (ref: label/merge_labels.cuh —
+union-find-flavored merge used by multi-batch clustering (DBSCAN-style):
+groups of ``labels_a`` are unioned with groups of ``labels_b`` wherever the
+two co-occur on masked rows, and every row adopts its union root.
+
+TPU re-design: the reference runs an iterative device union-find with
+atomics. Here the same fixpoint is reached with label propagation using
+segment-min + pointer jumping — the same machinery as
+sparse.solver.connected_components. Internally labels are kept as *root row
+ids* (always ≤ the row's own id), which makes the pointer-jump provably
+terminating; the result maps each row to the min row id of its merged group.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+@jax.jit
+def merge_labels(labels_a: jax.Array, labels_b: jax.Array, mask: jax.Array) -> jax.Array:
+    """Union a-groups (all rows) with b-groups (masked rows). Returns [n]
+    int32: min row id of each row's merged group."""
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    mask = jnp.asarray(mask, bool)
+    n = a.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    def dense_groups(labels, live):
+        """Relabel arbitrary int labels to dense ids in [0, n) (dead rows →
+        n) — labels may exceed n, so they cannot index segment arrays
+        directly."""
+        order = jnp.argsort(jnp.where(live, labels, jnp.iinfo(jnp.int32).max),
+                            stable=True)
+        s = labels[order]
+        first = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+        gid = jnp.cumsum(first) - 1
+        out = jnp.zeros(n, jnp.int32).at[order].set(gid.astype(jnp.int32))
+        return jnp.where(live, out, n)
+
+    ga = dense_groups(a, jnp.ones(n, bool))
+    gb = dense_groups(b, mask)
+
+    # init: every row → min row id of its a-group (≤ own id, so the
+    # pointer-jump below strictly descends and must terminate)
+    ra = jax.ops.segment_min(rows, ga, num_segments=n + 1)[:n]
+    cur0 = ra[ga]
+
+    def cond(state):
+        lab, changed = state
+        return changed
+
+    def body(state):
+        cur, _ = state
+        mina = jax.ops.segment_min(cur, ga, num_segments=n + 1)[:n]
+        minb = jax.ops.segment_min(
+            jnp.where(mask, cur, _INT_MAX), gb, num_segments=n + 1
+        )[:n]
+        upd = jnp.minimum(
+            mina[ga], jnp.where(mask, minb[gb % jnp.asarray(n, jnp.int32)], cur)
+        )
+        new = jnp.minimum(cur, upd)
+
+        def jump_cond(p):
+            return jnp.any(p[p] != p)
+
+        new = lax.while_loop(jump_cond, lambda p: p[p], jnp.minimum(new, new[new]))
+        return new, jnp.any(new != cur)
+
+    lab, _ = lax.while_loop(cond, body, (cur0, jnp.asarray(True)))
+    return lab

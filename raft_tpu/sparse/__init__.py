@@ -1,0 +1,7 @@
+"""Sparse formats, linear algebra, ops, distances, and graph primitives
+(ref: cpp/include/raft/sparse/)."""
+
+from raft_tpu.sparse.formats import COO, CSR
+from raft_tpu.sparse import convert, distance, linalg, neighbors, op, solver
+
+__all__ = ["COO", "CSR", "convert", "distance", "linalg", "neighbors", "op", "solver"]

@@ -1,0 +1,45 @@
+"""Format conversions (ref: sparse/convert/{coo,csr,dense}.cuh)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.formats import COO, CSR
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """Sorted COO → CSR (ref: sparse/convert/csr.cuh sorted_coo_to_csr)."""
+    s = coo.sorted_by_row()
+    n_rows = coo.shape[0]
+    counts = jnp.zeros(n_rows, jnp.int32).at[
+        jnp.where(s.valid, s.rows, n_rows)
+    ].add(jnp.where(s.valid, 1, 0), mode="drop")
+    indptr = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
+    )
+    return CSR(indptr, s.cols, jnp.where(s.valid, s.data, 0), coo.shape, coo.nnz)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """CSR → COO row expansion (ref: sparse/convert/coo.cuh csr_to_coo)."""
+    return COO(csr.row_ids(), csr.indices, csr.data, csr.shape, csr.nnz)
+
+
+def dense_to_csr(m: jax.Array, *, tol: float = 0.0) -> CSR:
+    """(ref: sparse/convert/csr.cuh dense_to_csr; host nnz discovery)"""
+    return CSR.from_dense(m, tol=tol)
+
+
+def dense_to_coo(m: jax.Array, *, tol: float = 0.0) -> COO:
+    return COO.from_dense(m, tol=tol)
+
+
+def csr_to_dense(csr: CSR) -> jax.Array:
+    """(ref: sparse/convert/dense.cuh)"""
+    return csr.to_dense()
+
+
+def coo_to_dense(coo: COO) -> jax.Array:
+    return coo.to_dense()

@@ -1,0 +1,80 @@
+"""Sparse pairwise distances (ref: sparse/distance/distance.cuh:75-126
+dispatch; detail/{l2,ip,lp,bin}_distance.cuh, coo_spmv strategies).
+
+TPU re-design: the reference's COO-SpMV expansion strategies exist because
+GPU shared memory can hold one sparse row per block. On TPU the MXU wants
+dense tiles, so the design is **tile-densify + dense kernel reuse**: stream
+row-blocks of each CSR operand into dense [tile, d] buffers and call the
+dense pairwise-distance path (SURVEY §2.6 "dense-fallback (BCOO)" note).
+Exact for every supported metric, memory-bounded by the tile size, and the
+inner loop is the same MXU matmul the dense path uses. A future Pallas CSR
+kernel can slot in behind the same API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import DISTANCE_TYPES, pairwise_distance
+from raft_tpu.sparse.formats import CSR
+
+
+def _densify_rows(csr: CSR, start: int, count: int) -> jax.Array:
+    """Rows [start, start+count) as a dense [count, n_cols] block."""
+    rows = csr.row_ids()
+    n_cols = csr.shape[1]
+    local = rows - start
+    in_tile = csr.valid & (local >= 0) & (local < count)
+    r = jnp.where(in_tile, local, count)
+    out = jnp.zeros((count + 1, n_cols), csr.data.dtype)
+    out = out.at[r, csr.indices].add(jnp.where(in_tile, csr.data, 0), mode="drop")
+    return out[:count]
+
+
+def pairwise_distance_sparse(
+    a: CSR,
+    b: CSR,
+    *,
+    metric: str = "sqeuclidean",
+    p: float = 2.0,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """All-pairs distance between CSR row sets → dense [a_rows, b_rows]
+    (ref: sparse/distance/distance.cuh pairwise_distance)."""
+    res = ensure(res)
+    if a.shape[1] != b.shape[1]:
+        raise ValueError(f"column mismatch {a.shape} vs {b.shape}")
+    DISTANCE_TYPES[metric]  # validate
+    n_a, n_b = a.shape[0], b.shape[0]
+    d = a.shape[1]
+    # tile so both densified blocks + the output tile fit the workspace
+    tile = max(1, min(max(n_a, n_b), res.workspace_rows(4 * (2 * d + n_b), cap=4096)))
+    # densify b blocks once and reuse them against every a block when the
+    # whole densified b fits the workspace; otherwise re-densify per a block
+    cache_b = 4 * n_b * d <= res.workspace_limit_bytes
+    b_blocks = (
+        [_densify_rows(b, t, min(tile, n_b - t)) for t in range(0, n_b, tile)]
+        if cache_b
+        else None
+    )
+    out_rows = []
+    for s in range(0, n_a, tile):
+        cnt = min(tile, n_a - s)
+        a_blk = _densify_rows(a, s, cnt)
+        col_parts = []
+        for bi, t in enumerate(range(0, n_b, tile)):
+            b_blk = (
+                b_blocks[bi]
+                if b_blocks is not None
+                else _densify_rows(b, t, min(tile, n_b - t))
+            )
+            col_parts.append(
+                pairwise_distance(a_blk, b_blk, metric=metric, p=p, res=res)
+            )
+        out_rows.append(jnp.concatenate(col_parts, axis=1))
+    return jnp.concatenate(out_rows, axis=0)

@@ -1,0 +1,140 @@
+"""Sparse matrix containers: COO and CSR.
+
+Reference: ``sparse/coo.hpp``, ``sparse/csr.hpp`` and the owning/view types in
+``core/{coo_matrix,csr_matrix,device_coo_matrix,device_csr_matrix}.hpp``
+(SURVEY §2.1, §2.6).
+
+TPU re-design: XLA requires static shapes, so a sparse container carries a
+*fixed capacity* of slots with an explicit valid count ``nnz``; slots past
+``nnz`` are padding (row = n_rows sentinel for COO padding, value 0). All
+arrays live on device as jnp arrays; both types are registered pytrees so
+they pass through jit/vmap/scan. Structure-mutating ops (dedupe, filter)
+produce new containers and are free to round-trip through host — exactly
+where the reference synchronizes its stream to compute new nnz.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def coo_order(rows, cols, valid, n_rows):
+    """Row-major (row, col) argsort with invalid slots last — composed from
+    two stable int32 sorts, so no wide key is needed (int32-safe at any
+    matrix size, unlike a rows*n_cols+cols key under disabled x64)."""
+    order = jnp.argsort(cols, stable=True)
+    r = jnp.where(valid, rows, n_rows)[order]
+    return order[jnp.argsort(r, stable=True)]
+
+
+@jax.tree_util.register_pytree_node_class
+class COO:
+    """Coordinate-format sparse matrix (ref: sparse/coo.hpp COO<T>).
+
+    rows/cols: [cap] int32 (padding rows = n_rows, cols = 0)
+    data:      [cap] float
+    nnz:       python int ≤ cap (static)
+    """
+
+    def __init__(self, rows, cols, data, shape: Tuple[int, int], nnz=None):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.cols = jnp.asarray(cols, jnp.int32)
+        self.data = jnp.asarray(data)
+        self.shape = tuple(shape)
+        self.nnz = int(nnz) if nnz is not None else int(self.rows.shape[0])
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.data), (self.shape, self.nnz)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        rows, cols, data = children
+        return cls(rows, cols, data, aux[0], aux[1])
+
+    @property
+    def cap(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def valid(self) -> jax.Array:
+        """[cap] bool mask of live slots."""
+        return jnp.arange(self.cap) < self.nnz
+
+    @classmethod
+    def from_dense(cls, m, *, tol: float = 0.0) -> "COO":
+        """Dense → COO (host-side nnz discovery; ref: sparse/convert/coo)."""
+        m = np.asarray(m)
+        r, c = np.nonzero(np.abs(m) > tol)
+        return cls(r.astype(np.int32), c.astype(np.int32), m[r, c], m.shape)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.data.dtype)
+        v = self.valid
+        r = jnp.where(v, self.rows, self.shape[0])  # padding → dropped row
+        return out.at[r, self.cols].add(jnp.where(v, self.data, 0), mode="drop")
+
+    def sorted_by_row(self) -> "COO":
+        """Row-major (then col) ordering with padding pushed to the end."""
+        order = coo_order(self.rows, self.cols, self.valid, self.shape[0])
+        return COO(
+            self.rows[order], self.cols[order], self.data[order], self.shape, self.nnz
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+class CSR:
+    """Compressed-sparse-row matrix (ref: sparse/csr.hpp / core/csr_matrix.hpp).
+
+    indptr:  [n_rows+1] int32 (indptr[n_rows] == nnz)
+    indices: [cap] int32 column ids (padding = 0)
+    data:    [cap] float (padding = 0)
+    """
+
+    def __init__(self, indptr, indices, data, shape: Tuple[int, int], nnz=None):
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.data = jnp.asarray(data)
+        self.shape = tuple(shape)
+        self.nnz = int(nnz) if nnz is not None else int(self.indices.shape[0])
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), (self.shape, self.nnz)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        indptr, indices, data = children
+        return cls(indptr, indices, data, aux[0], aux[1])
+
+    @property
+    def cap(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def valid(self) -> jax.Array:
+        return jnp.arange(self.cap) < self.nnz
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr → per-slot row ids [cap] (padding slots → n_rows).
+        The reference calls this csr_to_coo / expand (sparse/convert/coo.cuh)."""
+        # row of slot i = (# row starts ≤ i) − 1, via searchsorted
+        slots = jnp.arange(self.cap)
+        rows = jnp.searchsorted(self.indptr, slots, side="right") - 1
+        return jnp.where(self.valid, rows.astype(jnp.int32), self.shape[0])
+
+    @classmethod
+    def from_dense(cls, m, *, tol: float = 0.0) -> "CSR":
+        m = np.asarray(m)
+        mask = np.abs(m) > tol
+        indptr = np.concatenate([[0], np.cumsum(mask.sum(1))]).astype(np.int32)
+        r, c = np.nonzero(mask)
+        return cls(indptr, c.astype(np.int32), m[r, c], m.shape)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.data.dtype)
+        r = self.row_ids()
+        v = self.valid
+        return out.at[r, self.indices].add(jnp.where(v, self.data, 0), mode="drop")

@@ -1,0 +1,130 @@
+"""Sparse linear algebra (ref: sparse/linalg/ — spmm, sddmm, masked_matmul,
+transpose, symmetrize, degree, norm).
+
+TPU re-design: cuSPARSE calls become gather + ``segment_sum`` programs — the
+XLA-native formulation of edge-parallel sparse work (SURVEY §2.6 TPU note).
+Value-level functions (spmm, sddmm, masked_matmul, norms, degree) are
+static-shape over the container's slot capacity and trace under jit;
+structure-mutating ops (transpose keeps capacity and traces; symmetrize
+changes nnz and therefore host-syncs for the new count, like the
+reference's stream-sync before sizing outputs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.formats import COO, CSR, coo_order
+
+
+def spmm(csr: CSR, b: jax.Array) -> jax.Array:
+    """CSR × dense → dense (ref: sparse/linalg/spmm.cuh over cuSPARSE).
+
+    Edge-parallel: out[row[e]] += data[e] * b[col[e]] via one gather and one
+    segment_sum — both VPU/HBM friendly and fusible by XLA."""
+    rows = csr.row_ids()                      # padding → n_rows, dropped below
+    contrib = jnp.where(csr.valid[:, None], csr.data[:, None] * b[csr.indices], 0)
+    return jax.ops.segment_sum(contrib, rows, num_segments=csr.shape[0] + 1)[:-1]
+
+
+def spmv(csr: CSR, x: jax.Array) -> jax.Array:
+    return spmm(csr, x[:, None])[:, 0]
+
+
+def sddmm(csr: CSR, a: jax.Array, b: jax.Array, *, alpha=1.0, beta=0.0) -> CSR:
+    """Sampled dense-dense matmul: out_data[e] = α·(A[row[e]]·B[col[e]]) + β·C
+    (ref: sparse/linalg/sddmm.cuh). b is [n_cols, d] (row-major second factor)."""
+    rows = jnp.clip(csr.row_ids(), 0, csr.shape[0] - 1)
+    av = a[rows]                              # [cap, d]
+    bv = b[csr.indices]                       # [cap, d]
+    vals = alpha * jnp.sum(av * bv, axis=1) + beta * csr.data
+    vals = jnp.where(csr.valid, vals, 0)
+    return CSR(csr.indptr, csr.indices, vals, csr.shape, csr.nnz)
+
+
+def masked_matmul(mask: COO, a: jax.Array, b: jax.Array) -> COO:
+    """A·Bᵀ evaluated only at mask positions (ref: sparse/linalg/masked_matmul.cuh)."""
+    r = jnp.clip(mask.rows, 0, a.shape[0] - 1)
+    c = jnp.clip(mask.cols, 0, b.shape[0] - 1)
+    vals = jnp.where(mask.valid, jnp.sum(a[r] * b[c], axis=1), 0)
+    return COO(mask.rows, mask.cols, vals, mask.shape, mask.nnz)
+
+
+def transpose(csr: CSR) -> CSR:
+    """CSRᵀ via stable sort by column (ref: sparse/linalg/transpose.cuh
+    over cusparse csr2csc)."""
+    coo_rows = csr.row_ids()
+    n_rows, n_cols = csr.shape
+    order = coo_order(csr.indices, jnp.where(csr.valid, coo_rows, 0),
+                      csr.valid, n_cols)
+    new_cols = jnp.where(csr.valid[order], coo_rows[order], 0)
+    counts = jnp.zeros(n_cols, jnp.int32).at[
+        jnp.where(csr.valid, csr.indices, n_cols)
+    ].add(jnp.where(csr.valid, 1, 0), mode="drop")
+    indptr = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
+    )
+    data = jnp.where(csr.valid[order], csr.data[order], 0)
+    return CSR(indptr, new_cols, data, (n_cols, n_rows), csr.nnz)
+
+
+def symmetrize(coo: COO, *, op: str = "max") -> COO:
+    """Make A symmetric: combine A and Aᵀ entries with max/min/add/mean
+    (ref: sparse/linalg/symmetrize.cuh — used by kNN-graph pipelines).
+
+    Doubles the slot capacity (A ∪ Aᵀ) and reduces coincident (i, j) pairs
+    with the shared dedup in sparse.op (host-synced for the result nnz, like
+    every structure-mutating container op)."""
+    from raft_tpu.sparse.op import _reduce_duplicates
+
+    assert coo.shape[0] == coo.shape[1], "symmetrize needs a square matrix"
+    both = COO(
+        jnp.concatenate([coo.rows, coo.cols]),
+        jnp.concatenate([coo.cols, coo.rows]),
+        jnp.concatenate([coo.data, coo.data]),
+        coo.shape,
+        # interleave validity by placing pads at the end of each half; the
+        # COO valid mask is prefix-based, so rebuild with an explicit sort
+        2 * coo.cap,
+    )
+    # the concatenated halves each carry their own padding tail; compact the
+    # live slots to a prefix so the COO ``valid`` prefix-mask is correct
+    live = jnp.concatenate([coo.valid, coo.valid])
+    order = jnp.argsort(~live, stable=True)
+    both = COO(
+        both.rows[order], both.cols[order], both.data[order],
+        coo.shape, 2 * coo.nnz,
+    )
+    return _reduce_duplicates(both, op)
+
+
+def degree(coo: COO) -> jax.Array:
+    """Per-row nonzero count (ref: sparse/linalg/degree.cuh)."""
+    n = coo.shape[0]
+    return jnp.zeros(n, jnp.int32).at[
+        jnp.where(coo.valid, coo.rows, n)
+    ].add(jnp.where(coo.valid, 1, 0), mode="drop")
+
+
+def row_norm_csr(csr: CSR, *, norm_type: str = "l2") -> jax.Array:
+    """Per-row norms of a CSR matrix (ref: sparse/linalg/norm.cuh)."""
+    rows = csr.row_ids()
+    if norm_type == "l1":
+        v = jnp.abs(csr.data)
+    elif norm_type == "l2":
+        v = csr.data * csr.data
+    elif norm_type == "linf":
+        v = jnp.abs(csr.data)
+        m = jax.ops.segment_max(
+            jnp.where(csr.valid, v, -jnp.inf), rows, num_segments=csr.shape[0] + 1
+        )[:-1]
+        return jnp.maximum(m, 0.0)
+    else:
+        raise ValueError(f"unknown norm {norm_type}")
+    return jax.ops.segment_sum(
+        jnp.where(csr.valid, v, 0), rows, num_segments=csr.shape[0] + 1
+    )[:-1]

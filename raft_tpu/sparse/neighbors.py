@@ -1,0 +1,87 @@
+"""Sparse neighbors: brute-force kNN over CSR data + kNN-graph builder
+(ref: sparse/neighbors/{brute_force,knn,knn_graph}.cuh;
+cross_component_nn lives with the MST solver in raft_tpu.sparse.solver).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.ops.matrix import merge_topk, select_k
+from raft_tpu.sparse.distance import _densify_rows
+from raft_tpu.sparse.formats import COO, CSR
+
+
+def brute_force_knn(
+    dataset: CSR,
+    queries: CSR,
+    k: int,
+    *,
+    metric: str = "sqeuclidean",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN between sparse row sets — block-tiled distances + running
+    top-k merge (ref: sparse/neighbors/brute_force.cuh block-tiled design)."""
+    res = ensure(res)
+    n, d = dataset.shape
+    q = queries.shape[0]
+    if k > n:
+        raise ValueError(f"k={k} > dataset rows {n}")
+    from raft_tpu.distance.pairwise import pairwise_distance
+
+    tile = max(k, min(n, res.workspace_rows(4 * (2 * d + q), cap=4096)))
+    # densify query tiles once, reused against every dataset block
+    q_tiles = [
+        _densify_rows(queries, s, min(tile, q - s)) for s in range(0, q, tile)
+    ]
+    vals = idx = None
+    for s in range(0, n, tile):
+        cnt = min(tile, n - s)
+        blk = _densify_rows(dataset, s, cnt)
+        dist = jnp.concatenate(
+            [pairwise_distance(qb, blk, metric=metric, res=res) for qb in q_tiles],
+            axis=0,
+        )
+        kk = min(k, cnt)
+        v, i = select_k(dist, kk, select_min=True)
+        i = i + s
+        if kk < k:  # pad short first block so merge shapes line up
+            pad = k - kk
+            v = jnp.concatenate([v, jnp.full((q, pad), jnp.inf, v.dtype)], axis=1)
+            i = jnp.concatenate([i, jnp.full((q, pad), -1, i.dtype)], axis=1)
+        if vals is None:
+            vals, idx = v, i
+        else:
+            vals, idx = merge_topk(vals, idx, v, i, k)
+    return vals, idx
+
+
+def knn_graph(
+    dataset,
+    k: int,
+    *,
+    metric: str = "sqeuclidean",
+    res: Optional[Resources] = None,
+) -> COO:
+    """Symmetric kNN adjacency graph of a dense dataset as COO — the input to
+    MST/single-linkage pipelines (ref: sparse/neighbors/knn_graph.cuh)."""
+    from raft_tpu.neighbors import brute_force as dense_bf
+    from raft_tpu.sparse.linalg import symmetrize
+
+    res = ensure(res)
+    x = jnp.asarray(dataset, jnp.float32)
+    n = x.shape[0]
+    dists, ids = dense_bf.knn(x, x, k + 1, metric=metric, res=res)
+    # drop self column wherever it landed
+    self_col = ids == jnp.arange(n, dtype=ids.dtype)[:, None]
+    order = jnp.argsort(self_col, axis=1, stable=True)
+    ids = jnp.take_along_axis(ids, order, axis=1)[:, :k]
+    dists = jnp.take_along_axis(dists, order, axis=1)[:, :k]
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    coo = COO(rows, ids.reshape(-1), dists.reshape(-1), (n, n))
+    return symmetrize(coo, op="max")

@@ -1,0 +1,126 @@
+"""Sparse structure ops: sort, dedupe, filter, row slicing
+(ref: sparse/op/{sort,reduce,filter,row_op,slice}.cuh)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.formats import COO, CSR, coo_order
+
+
+def sort_coo(coo: COO) -> COO:
+    """Row-major sort (ref: sparse/op/sort.cuh coo_sort)."""
+    return coo.sorted_by_row()
+
+
+def max_duplicates(coo: COO) -> COO:
+    """Sum coincident (i, j) entries and compact (ref: sparse/op/reduce.cuh
+    max_duplicates — the reference keeps max; we expose both)."""
+    return _reduce_duplicates(coo, "max")
+
+
+def sum_duplicates(coo: COO) -> COO:
+    return _reduce_duplicates(coo, "add")
+
+
+def _reduce_duplicates(coo: COO, op: str) -> COO:
+    """Row-major sort, aggregate coincident (i, j) groups with ``op``
+    (add/mean/max/min), compact. Forces a host sync for the new nnz — like
+    every structure-mutating op on the fixed-capacity containers (and like
+    the reference, which syncs its stream to size the output)."""
+    n = coo.shape[0]
+    order = coo_order(coo.rows, coo.cols, coo.valid, n)
+    rows, cols, data, valid = (
+        coo.rows[order], coo.cols[order], coo.data[order], coo.valid[order]
+    )
+    first = jnp.concatenate(
+        [jnp.ones(1, bool),
+         (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1]) | ~valid[1:]]
+    )
+    seg = jnp.cumsum(first) - 1
+    m = rows.shape[0]
+    if op in ("add", "mean"):
+        agg = jax.ops.segment_sum(jnp.where(valid, data, 0), seg, num_segments=m)
+        if op == "mean":
+            cnt = jax.ops.segment_sum(
+                jnp.where(valid, 1.0, 0.0), seg, num_segments=m
+            )
+            agg = agg / jnp.maximum(cnt, 1.0)
+    elif op == "max":
+        agg = jax.ops.segment_max(
+            jnp.where(valid, data, -jnp.inf), seg, num_segments=m
+        )
+    elif op == "min":
+        agg = jax.ops.segment_min(
+            jnp.where(valid, data, jnp.inf), seg, num_segments=m
+        )
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    keep = first & valid
+    order2 = jnp.argsort(~keep, stable=True)
+    nnz = int(jnp.sum(keep))
+    return COO(
+        jnp.where(keep, rows, n)[order2],
+        jnp.where(keep, cols, 0)[order2],
+        jnp.where(keep, agg[seg], 0)[order2],
+        coo.shape,
+        nnz,
+    )
+
+
+def filter_values(coo: COO, *, threshold: float) -> COO:
+    """Drop entries with |value| ≤ threshold (ref: sparse/op/filter.cuh
+    coo_remove_scalar). Capacity is kept; padding grows."""
+    keep = coo.valid & (jnp.abs(coo.data) > threshold)
+    order = jnp.argsort(~keep, stable=True)
+    nnz = int(jnp.sum(keep))
+    n = coo.shape[0]
+    return COO(
+        jnp.where(keep, coo.rows, n)[order],
+        jnp.where(keep, coo.cols, 0)[order],
+        jnp.where(keep, coo.data, 0)[order],
+        coo.shape,
+        nnz,
+    )
+
+
+def filter_degree(coo: COO, *, min_degree: int) -> COO:
+    """Drop all entries of rows with degree < min_degree
+    (ref: sparse/op/filter.cuh remove low-degree rows)."""
+    n = coo.shape[0]
+    deg = jnp.zeros(n, jnp.int32).at[
+        jnp.where(coo.valid, coo.rows, n)
+    ].add(jnp.where(coo.valid, 1, 0), mode="drop")
+    keep = coo.valid & (deg[jnp.clip(coo.rows, 0, n - 1)] >= min_degree)
+    order = jnp.argsort(~keep, stable=True)
+    nnz = int(jnp.sum(keep))
+    return COO(
+        jnp.where(keep, coo.rows, n)[order],
+        jnp.where(keep, coo.cols, 0)[order],
+        jnp.where(keep, coo.data, 0)[order],
+        coo.shape,
+        nnz,
+    )
+
+
+def slice_rows(csr: CSR, start: int, stop: int) -> CSR:
+    """Contiguous row-range view → compacted CSR (ref: sparse/op/slice.cuh).
+    Host-side compaction (capacity changes)."""
+    indptr = np.asarray(csr.indptr)
+    indices = np.asarray(csr.indices)
+    data = np.asarray(csr.data)
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    new_ptr = indptr[start : stop + 1] - lo
+    return CSR(new_ptr, indices[lo:hi], data[lo:hi], (stop - start, csr.shape[1]))
+
+
+def row_op(csr: CSR, fn) -> CSR:
+    """Apply fn(row_id, values) per slot (ref: sparse/op/row_op.cuh csr_row_op).
+    fn maps ([cap] rows, [cap] data) → [cap] data."""
+    rows = csr.row_ids()
+    data = jnp.where(csr.valid, fn(rows, csr.data), 0)
+    return CSR(csr.indptr, csr.indices, data, csr.shape, csr.nnz)

@@ -1,0 +1,95 @@
+"""Single-linkage clustering + label utilities
+(mirrors cpp/test/cluster/linkage.cu + cpp/test/label/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.cluster import single_linkage
+from raft_tpu.label import get_classlabels, make_monotonic, merge_labels, relabel
+from raft_tpu.random import make_blobs
+
+
+def test_single_linkage_blobs():
+    key = jax.random.PRNGKey(0)
+    x, truth, _ = make_blobs(key, 300, 8, n_clusters=3, cluster_std=0.5)
+    out = single_linkage(np.asarray(x), n_clusters=3, c=10)
+    labels = np.asarray(out.labels)
+    truth = np.asarray(truth)
+    assert labels.shape == (300,)
+    assert len(np.unique(labels)) == 3
+    # perfect separation ⇒ labels are a permutation of truth (ARI == 1)
+    from raft_tpu.stats import adjusted_rand_index
+
+    ari = float(adjusted_rand_index(jnp.asarray(labels), jnp.asarray(truth)))
+    assert ari > 0.95, ari
+
+
+def test_single_linkage_matches_scipy():
+    from scipy.cluster.hierarchy import fcluster, linkage
+
+    rng = np.random.default_rng(1)
+    x = rng.random((80, 4))
+    # euclidean metric so deltas match scipy's 'single' linkage
+    out = single_linkage(x.astype(np.float32), n_clusters=4, c=20, metric="euclidean")
+    ref = fcluster(linkage(x, method="single", metric="euclidean"), 4, "maxclust")
+    from raft_tpu.stats import adjusted_rand_index
+
+    ari = float(
+        adjusted_rand_index(jnp.asarray(np.asarray(out.labels)), jnp.asarray(ref - 1))
+    )
+    assert ari > 0.9, ari
+    # dendrogram merge distances sorted ascending
+    assert (np.diff(out.deltas) >= -1e-6).all()
+
+
+def test_single_linkage_dendrogram_shapes():
+    rng = np.random.default_rng(2)
+    x = rng.random((50, 3)).astype(np.float32)
+    out = single_linkage(x, n_clusters=2, c=8)
+    assert out.dendrogram.shape == (49, 2)
+    assert out.sizes[-1] == 50  # final merge spans everything
+
+
+def test_classlabels():
+    labels = jnp.asarray(np.array([5, 3, 5, 9, 3, 3], np.int32))
+    classes = np.asarray(get_classlabels(labels))
+    np.testing.assert_array_equal(classes, [3, 5, 9])
+    mono = np.asarray(make_monotonic(labels))
+    np.testing.assert_array_equal(mono, [1, 0, 1, 2, 0, 0])
+    re = np.asarray(relabel(labels, np.array([5, 9]), np.array([50, 90])))
+    np.testing.assert_array_equal(re, [50, 3, 50, 90, 3, 3])
+
+
+def test_merge_labels():
+    # a-groups: {0,1}, {2,3}, {4,5}; b links rows 1 and 2 (masked) → union
+    a = jnp.asarray(np.array([0, 0, 2, 2, 4, 4], np.int32))
+    b = jnp.asarray(np.array([7, 1, 1, 8, 9, 9], np.int32))
+    mask = jnp.asarray(np.array([False, True, True, False, False, False]))
+    out = np.asarray(merge_labels(a, b, mask))
+    assert out[0] == out[1] == out[2] == out[3] == 0
+    assert out[4] == out[5] == 4
+
+
+def test_merge_labels_oob_b_groups_stay_distinct():
+    """Regression: b-label values ≥ n must not alias (an early clip mapped
+    every id ≥ n to n−1, silently unioning distinct groups)."""
+    a = jnp.asarray(np.arange(6, dtype=np.int32))
+    b = jnp.asarray(np.array([0, 0, 0, 7, 9, 9], np.int32))
+    mask = jnp.asarray(np.array([False, False, False, True, True, False]))
+    out = np.asarray(merge_labels(a, b, mask))
+    assert out[3] != out[4]
+    # and a genuinely shared oob group still merges
+    mask2 = jnp.asarray(np.array([False, False, False, False, True, True]))
+    out2 = np.asarray(merge_labels(a, b, mask2))
+    assert out2[4] == out2[5]
+
+
+def test_merge_labels_noop_mask():
+    a = jnp.asarray(np.array([1, 1, 3, 3], np.int32))
+    b = jnp.asarray(np.array([0, 2, 0, 2], np.int32))
+    mask = jnp.zeros(4, bool)
+    out = np.asarray(merge_labels(a, b, mask))
+    assert out[0] == out[1] and out[2] == out[3] and out[0] != out[2]

@@ -1,0 +1,304 @@
+"""Sparse formats/convert/linalg/op/distance/neighbors/solver vs
+scipy.sparse + dense references (mirrors cpp/test/sparse/)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse import COO, CSR, convert, distance, linalg, neighbors, op, solver
+
+
+@pytest.fixture
+def rand_sp(rng):
+    def make(n, m, density=0.2, seed=0):
+        r = np.random.default_rng(seed)
+        mat = sp.random(n, m, density=density, random_state=seed, dtype=np.float64)
+        d = np.asarray(mat.todense(), np.float32)
+        return d
+
+    return make
+
+
+def test_coo_roundtrip(rand_sp):
+    d = rand_sp(17, 23)
+    coo = COO.from_dense(d)
+    assert coo.nnz == int((d != 0).sum())
+    np.testing.assert_allclose(np.asarray(coo.to_dense()), d, rtol=1e-6)
+
+
+def test_csr_roundtrip(rand_sp):
+    d = rand_sp(17, 23)
+    csr = CSR.from_dense(d)
+    ref = sp.csr_matrix(d)
+    np.testing.assert_array_equal(np.asarray(csr.indptr), ref.indptr)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), d, rtol=1e-6)
+
+
+def test_conversions(rand_sp):
+    d = rand_sp(11, 13)
+    coo = COO.from_dense(d)
+    csr = convert.coo_to_csr(coo)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), d, rtol=1e-6)
+    coo2 = convert.csr_to_coo(csr)
+    np.testing.assert_allclose(np.asarray(coo2.to_dense()), d, rtol=1e-6)
+
+
+def test_csr_row_ids_with_padding(rand_sp):
+    d = rand_sp(9, 7)
+    csr = CSR.from_dense(d)
+    # grow capacity with padding slots
+    pad = 5
+    csr2 = CSR(
+        csr.indptr,
+        np.concatenate([np.asarray(csr.indices), np.zeros(pad, np.int32)]),
+        np.concatenate([np.asarray(csr.data), np.zeros(pad, np.float32)]),
+        csr.shape,
+        csr.nnz,
+    )
+    rid = np.asarray(csr2.row_ids())
+    assert (rid[csr.nnz :] == 9).all()
+    np.testing.assert_allclose(np.asarray(csr2.to_dense()), d, rtol=1e-6)
+
+
+def test_spmm_spmv(rand_sp, rng):
+    d = rand_sp(20, 30)
+    b = rng.random((30, 8), dtype=np.float32)
+    csr = CSR.from_dense(d)
+    np.testing.assert_allclose(np.asarray(linalg.spmm(csr, b)), d @ b, rtol=1e-4, atol=1e-5)
+    x = rng.random(30, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.spmv(csr, x)), d @ x, rtol=1e-4, atol=1e-5)
+
+
+def test_sddmm(rand_sp, rng):
+    d = rand_sp(12, 18, density=0.3)
+    a = rng.random((12, 6), dtype=np.float32)
+    b = rng.random((18, 6), dtype=np.float32)
+    csr = CSR.from_dense(d)
+    out = linalg.sddmm(csr, a, b, alpha=2.0, beta=0.5)
+    dense = np.asarray(out.to_dense())
+    ref = (2.0 * (a @ b.T) + 0.5 * d) * (d != 0)
+    np.testing.assert_allclose(dense, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_matmul(rand_sp, rng):
+    d = rand_sp(10, 14, density=0.25)
+    a = rng.random((10, 5), dtype=np.float32)
+    b = rng.random((14, 5), dtype=np.float32)
+    mask = COO.from_dense((d != 0).astype(np.float32))
+    out = linalg.masked_matmul(mask, a, b)
+    ref = (a @ b.T) * (d != 0)
+    np.testing.assert_allclose(np.asarray(out.to_dense()), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transpose(rand_sp):
+    d = rand_sp(15, 9)
+    csr = CSR.from_dense(d)
+    t = linalg.transpose(csr)
+    assert t.shape == (9, 15)
+    np.testing.assert_allclose(np.asarray(t.to_dense()), d.T, rtol=1e-6)
+    ref = sp.csr_matrix(d.T)
+    np.testing.assert_array_equal(np.asarray(t.indptr), ref.indptr)
+
+
+@pytest.mark.parametrize("sym_op", ["max", "min", "add", "mean"])
+def test_symmetrize(rand_sp, sym_op):
+    d = rand_sp(12, 12, density=0.2)
+    coo = COO.from_dense(d)
+    s = linalg.symmetrize(coo, op=sym_op)
+    dense = np.asarray(s.to_dense())
+    a, at = d, d.T
+    both = (a != 0) | (at != 0)
+    if sym_op == "max":
+        ref = np.maximum(a, at)
+    elif sym_op == "min":
+        # min over *present* entries: where only one side present, keep it
+        ref = np.where((a != 0) & (at != 0), np.minimum(a, at), a + at)
+    elif sym_op == "add":
+        ref = a + at
+    else:
+        ref = np.where((a != 0) & (at != 0), (a + at) / 2, a + at)
+    ref = ref * both
+    if sym_op == "min":
+        # our min aggregates actual stored values; scipy-style comparison
+        # only meaningful where both present
+        m = (a != 0) & (at != 0)
+        np.testing.assert_allclose(dense[m], np.minimum(a, at)[m], rtol=1e-5)
+    elif sym_op == "max":
+        np.testing.assert_allclose(dense, ref, rtol=1e-5)
+    else:
+        np.testing.assert_allclose(dense, ref, rtol=1e-5)
+    # symmetric
+    np.testing.assert_allclose(dense, dense.T, rtol=1e-6)
+
+
+def test_degree_norm(rand_sp):
+    d = rand_sp(13, 11)
+    coo = COO.from_dense(d)
+    np.testing.assert_array_equal(np.asarray(linalg.degree(coo)), (d != 0).sum(1))
+    csr = CSR.from_dense(d)
+    np.testing.assert_allclose(
+        np.asarray(linalg.row_norm_csr(csr, norm_type="l1")),
+        np.abs(d).sum(1), rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(linalg.row_norm_csr(csr, norm_type="l2")),
+        (d * d).sum(1), rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(linalg.row_norm_csr(csr, norm_type="linf")),
+        np.abs(d).max(1), rtol=1e-5,
+    )
+
+
+def test_dedupe_and_filter():
+    rows = np.array([0, 0, 1, 2, 0], np.int32)
+    cols = np.array([1, 1, 2, 0, 1], np.int32)
+    data = np.array([1.0, 3.0, 2.0, 4.0, 2.0], np.float32)
+    coo = COO(rows, cols, data, (3, 3))
+    summed = op.sum_duplicates(coo)
+    assert summed.nnz == 3
+    dense = np.asarray(summed.to_dense())
+    assert dense[0, 1] == 6.0 and dense[1, 2] == 2.0 and dense[2, 0] == 4.0
+    maxed = op.max_duplicates(coo)
+    assert np.asarray(maxed.to_dense())[0, 1] == 3.0
+    filt = op.filter_values(summed, threshold=2.5)
+    assert filt.nnz == 2
+    dense = np.asarray(filt.to_dense())
+    assert dense[0, 1] == 6.0 and dense[2, 0] == 4.0
+
+
+def test_filter_degree(rand_sp):
+    d = rand_sp(10, 10, density=0.3)
+    coo = COO.from_dense(d)
+    out = op.filter_degree(coo, min_degree=3)
+    deg = (d != 0).sum(1)
+    dense = np.asarray(out.to_dense())
+    for r in range(10):
+        if deg[r] < 3:
+            assert (dense[r] == 0).all()
+        else:
+            np.testing.assert_allclose(dense[r], d[r], rtol=1e-6)
+
+
+def test_slice_rows(rand_sp):
+    d = rand_sp(12, 8)
+    csr = CSR.from_dense(d)
+    s = op.slice_rows(csr, 3, 9)
+    np.testing.assert_allclose(np.asarray(s.to_dense()), d[3:9], rtol=1e-6)
+
+
+def test_sparse_pairwise_distance(rand_sp):
+    import scipy.spatial.distance as sd
+
+    a = rand_sp(25, 40, density=0.3, seed=1)
+    b = rand_sp(19, 40, density=0.3, seed=2)
+    ca, cb = CSR.from_dense(a), CSR.from_dense(b)
+    for metric, ref_metric in [
+        ("sqeuclidean", "sqeuclidean"),
+        ("cosine", "cosine"),
+        ("cityblock", "cityblock"),
+    ]:
+        got = np.asarray(
+            distance.pairwise_distance_sparse(ca, cb, metric=metric)
+        )
+        want = sd.cdist(a, b, ref_metric)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_sparse_brute_force_knn(rand_sp):
+    import scipy.spatial.distance as sd
+
+    data = rand_sp(200, 32, density=0.4, seed=3)
+    q = rand_sp(23, 32, density=0.4, seed=4)
+    cd, cq = CSR.from_dense(data), CSR.from_dense(q)
+    vals, idx = neighbors.brute_force_knn(cd, cq, 5)
+    ref = np.argsort(sd.cdist(q, data, "sqeuclidean"), axis=1)[:, :5]
+    np.testing.assert_array_equal(np.asarray(idx), ref)
+
+
+def test_knn_graph_symmetric(rng):
+    x = rng.random((60, 8), dtype=np.float32)
+    g = neighbors.knn_graph(x, 4)
+    dense = np.asarray(g.to_dense())
+    np.testing.assert_allclose(dense, dense.T, rtol=1e-5)
+    assert (np.asarray(linalg.degree(g))[: g.shape[0]] >= 4).all()
+
+
+# ------------------------------------------------------------------
+# solver: MST + connected components + cross-component NN
+# ------------------------------------------------------------------
+
+def test_mst_matches_scipy(rng):
+    from scipy.sparse.csgraph import minimum_spanning_tree
+
+    n = 40
+    x = rng.random((n, 3), dtype=np.float32)
+    d = ((x[:, None] - x[None, :]) ** 2).sum(-1)
+    # dense complete graph as COO (no self loops)
+    r, c = np.nonzero(~np.eye(n, dtype=bool))
+    coo = COO(r.astype(np.int32), c.astype(np.int32), d[r, c].astype(np.float32), (n, n))
+    tree, comp, total = solver.mst(coo)
+    ref = minimum_spanning_tree(sp.csr_matrix(d)).toarray()
+    np.testing.assert_allclose(float(total), ref.sum(), rtol=1e-4)
+    assert tree.nnz == n - 1
+    assert len(np.unique(np.asarray(comp))) == 1
+
+
+def test_mst_disconnected(rng):
+    # two cliques, no cross edges → spanning forest with 2 trees
+    n = 20
+    x = rng.random((n, 2), dtype=np.float32)
+    rows, cols, data = [], [], []
+    for grp in (range(0, 10), range(10, 20)):
+        for i in grp:
+            for j in grp:
+                if i != j:
+                    rows.append(i); cols.append(j)
+                    data.append(((x[i] - x[j]) ** 2).sum())
+    coo = COO(np.asarray(rows, np.int32), np.asarray(cols, np.int32),
+              np.asarray(data, np.float32), (n, n))
+    tree, comp, _ = solver.mst(coo)
+    assert tree.nnz == n - 2
+    assert len(np.unique(np.asarray(comp))) == 2
+
+
+def test_mst_equal_weights_terminates():
+    # all-equal weights exercise the lexicographic tie-break (3-cycle trap)
+    n = 9
+    r, c = np.nonzero(~np.eye(n, dtype=bool))
+    coo = COO(r.astype(np.int32), c.astype(np.int32),
+              np.ones(r.size, np.float32), (n, n))
+    tree, comp, total = solver.mst(coo)
+    assert tree.nnz == n - 1
+    assert float(total) == n - 1
+
+
+def test_connected_components():
+    # chain 0-1-2, pair 3-4, singleton 5
+    rows = np.array([0, 1, 3], np.int32)
+    cols = np.array([1, 2, 4], np.int32)
+    coo = COO(rows, cols, np.ones(3, np.float32), (6, 6))
+    comp = np.asarray(solver.connected_components(coo))
+    assert comp[0] == comp[1] == comp[2]
+    assert comp[3] == comp[4]
+    assert comp[5] not in (comp[0], comp[3])
+
+
+def test_cross_component_nn(rng):
+    x = np.concatenate([
+        rng.random((10, 2), dtype=np.float32),
+        rng.random((10, 2), dtype=np.float32) + 10.0,
+    ])
+    labels = np.array([0] * 10 + [1] * 10, np.int32)
+    edges = solver.cross_component_nn(x, labels)
+    assert edges.nnz >= 1
+    r = np.asarray(edges.rows)[: edges.nnz]
+    c = np.asarray(edges.cols)[: edges.nnz]
+    assert (labels[r] != labels[c]).all()
+    # the connecting edge is the true min cross distance
+    d = ((x[:10, None] - x[None, 10:]) ** 2).sum(-1)
+    got = float(np.asarray(edges.data)[: edges.nnz].min())
+    np.testing.assert_allclose(got, d.min(), rtol=1e-4)
