@@ -13,8 +13,12 @@ from raft_tpu.cluster.kmeans import (
 )
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.single_linkage import SingleLinkageOutput, single_linkage
+from raft_tpu.cluster import spectral
+from raft_tpu.cluster.auto_find_k import find_k
 
 __all__ = [
+    "spectral",
+    "find_k",
     "SingleLinkageOutput",
     "single_linkage",
     "KMeansParams",

@@ -1,0 +1,66 @@
+"""Automatic k search for k-means.
+
+Reference: ``cluster/kmeans_auto_find_k.cuh`` (find_k) — bisection over k
+guided by the relative inertia improvement, stopping when adding clusters no
+longer buys a ``threshold`` fraction of cost.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans
+from raft_tpu.core.resources import Resources, ensure
+
+
+def find_k(
+    x: jax.Array,
+    kmax: int,
+    *,
+    kmin: int = 1,
+    threshold: float = 0.05,
+    max_iter: int = 100,
+    seed: int = 0,
+    res: Optional[Resources] = None,
+) -> Tuple[int, jax.Array, jax.Array]:
+    """Search [kmin, kmax] for the inertia elbow.
+
+    Returns (k, centroids [k, d], inertia) (ref: kmeans_auto_find_k.cuh
+    find_k — same bisection-on-improvement idea)."""
+    res = ensure(res)
+    x = jnp.asarray(x, jnp.float32)
+    if not (1 <= kmin <= kmax <= x.shape[0]):
+        raise ValueError(f"bad k range [{kmin}, {kmax}] for n={x.shape[0]}")
+
+    def cost(k: int):
+        params = kmeans.KMeansParams(
+            n_clusters=k, max_iter=max_iter, seed=seed
+        )
+        centers, inertia, _ = kmeans.fit(params, x, res=res)
+        return centers, float(inertia)
+
+    cache = {}
+
+    def cost_cached(k: int):
+        if k not in cache:
+            cache[k] = cost(k)
+        return cache[k]
+
+    lo, hi = kmin, kmax
+    _, c_lo = cost_cached(lo)
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        _, c_mid = cost_cached(mid)
+        # relative improvement per added cluster from lo → mid
+        gain = (c_lo - c_mid) / max(c_lo, 1e-30) / max(mid - lo, 1)
+        if gain > threshold:
+            lo, c_lo = mid, c_mid
+        else:
+            hi = mid
+    best_k = lo
+    centers, inertia = cost_cached(best_k)
+    return best_k, centers, jnp.asarray(inertia)

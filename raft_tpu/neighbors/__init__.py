@@ -1,6 +1,35 @@
 """Nearest-neighbor indexes (ref: cpp/include/raft/neighbors/)."""
 
-from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, nn_descent
+from raft_tpu.neighbors import (
+    ball_cover,
+    brute_force,
+    cagra,
+    extras,
+    hnsw,
+    ivf_flat,
+    ivf_pq,
+    nn_descent,
+    vpq_dataset,
+)
+from raft_tpu.neighbors.extras import (
+    BatchKQuery,
+    epsilon_neighborhood,
+    masked_l2_nn,
+)
 from raft_tpu.neighbors.refine import refine
 
-__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "nn_descent", "refine"]
+__all__ = [
+    "ball_cover",
+    "brute_force",
+    "cagra",
+    "extras",
+    "hnsw",
+    "ivf_flat",
+    "ivf_pq",
+    "nn_descent",
+    "vpq_dataset",
+    "refine",
+    "BatchKQuery",
+    "epsilon_neighborhood",
+    "masked_l2_nn",
+]

@@ -76,9 +76,11 @@ class SearchParams:
 
 class Index:
     """CAGRA index: dataset + fixed-degree directed graph
-    (ref: cagra_types.hpp:142 index{dataset, graph})."""
+    (ref: cagra_types.hpp:142 index{dataset, graph}). ``dataset`` is either
+    a dense [n, d] array or a ``vpq_dataset.VpqDataset`` (the reference's
+    compressed-dataset option, dataset.hpp:37-259)."""
 
-    def __init__(self, metric: str, dataset: jax.Array, graph: jax.Array):
+    def __init__(self, metric: str, dataset, graph: jax.Array):
         self.metric = metric
         self.dataset = dataset
         self.graph = graph
@@ -94,6 +96,19 @@ class Index:
     @property
     def graph_degree(self) -> int:
         return self.graph.shape[1]
+
+
+def compress(index: Index, params=None, *, res: Optional[Resources] = None) -> Index:
+    """Replace the dense dataset with a VPQ-compressed one; search then
+    decodes candidates on the fly and distances become approximate
+    (ref: cagra index_params.compression + compute_distance_vpq.cuh)."""
+    from raft_tpu.neighbors import vpq_dataset
+
+    if not isinstance(index.dataset, jax.Array):
+        raise ValueError("index dataset is already compressed")
+    params = params or vpq_dataset.VpqParams()
+    ds = vpq_dataset.build(params, index.dataset, res=res)
+    return Index(index.metric, ds, index.graph)
 
 
 # --------------------------------------------------------------------------
@@ -287,6 +302,14 @@ def _query_distance(qs: jax.Array, vecs: jax.Array, metric: str) -> jax.Array:
     return jnp.maximum(q2[:, None] + v2 - 2.0 * ip, 0.0)
 
 
+def _gather_rows(dataset, ids):
+    """Candidate-row gather: dense take or VPQ decode-on-gather
+    (ref: compute_distance_vpq.cuh decodes codes inside the kernel)."""
+    if isinstance(dataset, jax.Array):
+        return dataset[jnp.clip(ids, 0, dataset.shape[0] - 1)]
+    return dataset.decode(ids)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "metric", "tile"),
@@ -314,7 +337,7 @@ def _search_jit(
     def one_tile(args):
         qs, seeds = args                                  # [t, d], [t, s]
         # ---- random init (ref: random_samplings init of itopk candidates)
-        vecs = dataset[jnp.clip(seeds, 0, n - 1)]
+        vecs = _gather_rows(dataset, seeds)
         dists = _query_distance(qs, vecs, metric)
         dists = jnp.where(seeds < 0, jnp.inf, dists)
         # dedupe seeds, take itopk best
@@ -355,7 +378,7 @@ def _search_jit(
             nbrs = graph[jnp.clip(parents, 0, n - 1)]             # [t, w, deg]
             nbrs = jnp.where(parent_ok[:, :, None], nbrs, -1)
             cand = nbrs.reshape(tile, width * deg)
-            vecs = dataset[jnp.clip(cand, 0, n - 1)]              # [t, w*deg, d]
+            vecs = _gather_rows(dataset, cand)                    # [t, w*deg, d]
             cd = _query_distance(qs, vecs, metric)
             cd = jnp.where(cand < 0, jnp.inf, cd)
             # ---- fold filter-passing candidates into the result buffer.

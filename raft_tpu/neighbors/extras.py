@@ -1,0 +1,122 @@
+"""Smaller neighbor primitives: ε-neighborhood, masked 1-NN, incremental
+batch queries.
+
+Reference: ``neighbors/epsilon_neighborhood.cuh:101`` (epsUnexpL2SqNeighborhood),
+``distance/masked_nn.cuh`` (masked_l2_nn over a bigraph adjacency),
+``neighbors/detail/knn_brute_force_batch_k_query.cuh`` (batch_k_query).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import _PREC, pairwise_distance
+from raft_tpu.neighbors import brute_force
+from raft_tpu.ops.matrix import select_k
+
+
+def epsilon_neighborhood(
+    x: jax.Array,
+    y: jax.Array,
+    eps_sq: float,
+    *,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Boolean adjacency adj[i,j] = ‖x_i − y_j‖² ≤ eps² plus per-row degree
+    (ref: epsilon_neighborhood.cuh eps_neighbors_l2sq — same dense-bool
+    output + vertex degree array)."""
+    res = ensure(res)
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    m, n = x.shape[0], y.shape[0]
+    tile = max(1, min(m, res.workspace_rows(4 * n + n, cap=8192)))
+    adjs, degs = [], []
+    for s in range(0, m, tile):
+        d = pairwise_distance(x[s : s + tile], y, metric="sqeuclidean", res=res)
+        a = d <= eps_sq
+        adjs.append(a)
+        degs.append(jnp.sum(a, axis=1).astype(jnp.int32))
+    return jnp.concatenate(adjs, axis=0), jnp.concatenate(degs)
+
+
+@functools.partial(jax.jit, static_argnames=("sqrt",))
+def masked_l2_nn(
+    x: jax.Array,
+    y: jax.Array,
+    adj: jax.Array,
+    group_idxs: jax.Array,
+    *,
+    sqrt: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked fused L2 1-NN (ref: distance/masked_nn.cuh masked_l2_nn).
+
+    ``adj`` [m, num_groups] marks which y-groups each x row may match;
+    ``group_idxs`` [num_groups] are *exclusive end offsets* of contiguous
+    y groups (the reference's group layout). Returns (min_dist [m],
+    argmin [m]); rows with no admissible y get (inf, −1)."""
+    m, k = adj.shape
+    n = y.shape[0]
+    # group id of each y row from the end-offsets
+    gid = jnp.searchsorted(group_idxs, jnp.arange(n), side="right")
+    allowed = adj[:, jnp.clip(gid, 0, k - 1)]          # [m, n]
+    x2 = jnp.sum(x * x, axis=1)
+    y2 = jnp.sum(y * y, axis=1)
+    d = x2[:, None] + y2[None, :] - 2.0 * jnp.matmul(x, y.T, precision=_PREC)
+    d = jnp.where(allowed, jnp.maximum(d, 0.0), jnp.inf)
+    j = jnp.argmin(d, axis=1).astype(jnp.int32)
+    v = jnp.take_along_axis(d, j[:, None], axis=1)[:, 0]
+    j = jnp.where(jnp.isfinite(v), j, -1)
+    if sqrt:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, j
+
+
+class BatchKQuery:
+    """Incremental-k query: iterate over successive batches of neighbors
+    (ref: brute_force batch_k_query — amortizes one big select across
+    consumers that want k in pages).
+
+    The TPU realization computes top-(batch_size · n_batches_consumed)
+    lazily: each ``next()`` re-selects only when the cached horizon is
+    exceeded, doubling the horizon to amortize (capacity-doubling like the
+    reference's conservative re-query)."""
+
+    def __init__(self, dataset, queries, batch_size: int, *,
+                 metric: str = "sqeuclidean", res: Optional[Resources] = None):
+        self.res = ensure(res)
+        self.dataset = jnp.asarray(dataset, jnp.float32)
+        self.queries = jnp.asarray(queries, jnp.float32)
+        self.batch_size = int(batch_size)
+        self.metric = metric
+        self._pos = 0
+        self._vals = None
+        self._ids = None
+
+    def _ensure(self, upto: int):
+        have = 0 if self._vals is None else self._vals.shape[1]
+        if upto <= have:
+            return
+        horizon = min(self.dataset.shape[0], max(upto, 2 * max(have, self.batch_size)))
+        self._vals, self._ids = brute_force.knn(
+            self.dataset, self.queries, horizon, metric=self.metric, res=self.res
+        )
+
+    def __iter__(self):
+        self._pos = 0
+        return self
+
+    def __next__(self):
+        if self._pos >= self.dataset.shape[0]:
+            raise StopIteration
+        end = min(self._pos + self.batch_size, self.dataset.shape[0])
+        self._ensure(end)
+        v = self._vals[:, self._pos : end]
+        i = self._ids[:, self._pos : end]
+        self._pos = end
+        return v, i

@@ -1,0 +1,120 @@
+"""HNSW interop: export a CAGRA index to hnswlib's binary format and search
+hnswlib-format files.
+
+Reference: ``neighbors/hnsw.hpp:37-57`` + ``detail/hnsw.hpp:24-74`` (wrap a
+CAGRA graph as the hnswlib base layer; CPU search through hnswlib) and the
+writer ``detail/cagra/cagra_serialize.cuh serialize_to_hnswlib:96-203``
+(field-for-field binary layout reproduced here: header of size_t/int fields,
+then per-element [link_count:int32, links:uint32×deg, vector:f32×dim,
+label:size_t], then one zero int per element for the absent upper levels).
+
+The exported file loads in stock hnswlib (`hnswlib.Index(space='l2', dim=d)
+.load_index(path)`). Since hnswlib is not bundled in this environment, the
+module also parses the format back and searches it with the CAGRA beam
+engine — the capability the reference gets from its hnswlib dependency.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.neighbors import cagra
+
+
+def serialize_to_hnswlib(filename: str, index: "cagra.Index") -> None:
+    """Write a CAGRA index as an hnswlib level-0-only index file
+    (ref: cagra_serialize.cuh serialize_to_hnswlib)."""
+    data = np.asarray(index.dataset, np.float32)
+    graph = np.asarray(index.graph, np.uint32)
+    n, dim = data.shape
+    deg = graph.shape[1]
+    size_data_per_element = deg * 4 + 4 + dim * 4 + 8
+    with open(filename, "wb") as fh:
+        fh.write(struct.pack("<Q", 0))                        # offset_level_0
+        fh.write(struct.pack("<Q", n))                        # max_element
+        fh.write(struct.pack("<Q", n))                        # curr_element_count
+        fh.write(struct.pack("<Q", size_data_per_element))
+        fh.write(struct.pack("<Q", size_data_per_element - 8))  # label_offset
+        fh.write(struct.pack("<Q", deg * 4 + 4))              # offset_data
+        fh.write(struct.pack("<i", 1))                        # max_level
+        fh.write(struct.pack("<i", n // 2))                   # entrypoint_node
+        fh.write(struct.pack("<Q", deg // 2))                 # max_M
+        fh.write(struct.pack("<Q", deg))                      # max_M0
+        fh.write(struct.pack("<Q", deg // 2))                 # M
+        fh.write(struct.pack("<d", 0.42424242))               # mult (unused)
+        fh.write(struct.pack("<Q", 500))                      # ef_construction
+        # level-0 memory: one element at a time
+        block = np.zeros(size_data_per_element, np.uint8)
+        for i in range(n):
+            off = 0
+            block[0:4] = np.frombuffer(struct.pack("<i", deg), np.uint8)
+            block[4 : 4 + deg * 4] = graph[i].view(np.uint8)
+            off = 4 + deg * 4
+            block[off : off + dim * 4] = data[i].view(np.uint8)
+            off += dim * 4
+            block[off : off + 8] = np.frombuffer(struct.pack("<Q", i), np.uint8)
+            fh.write(block.tobytes())
+        # upper-level link lists: all absent
+        fh.write(np.zeros(n, np.int32).tobytes())
+
+
+def load(filename: str, dim: int, *, metric: str = "sqeuclidean") -> "cagra.Index":
+    """Parse an hnswlib index file's base layer into a searchable index
+    (ref: hnsw.hpp from_cagra/deserialize — the inverse wrapper). Elements
+    are re-ordered by their stored labels so returned neighbor ids are
+    labels, like hnswlib's knn_query."""
+    with open(filename, "rb") as fh:
+        header = fh.read(8 * 6)
+        (_, max_el, n, size_per, label_off, offset_data) = struct.unpack(
+            "<6Q", header
+        )
+        _max_level, _entry = struct.unpack("<2i", fh.read(8))
+        max_m, max_m0, _m = struct.unpack("<3Q", fh.read(24))
+        _mult = struct.unpack("<d", fh.read(8))[0]
+        _efc = struct.unpack("<Q", fh.read(8))[0]
+        level0 = np.frombuffer(fh.read(n * size_per), np.uint8).reshape(n, size_per)
+    deg = (offset_data - 4) // 4
+    if label_off != size_per - 8 or offset_data + dim * 4 != label_off:
+        raise ValueError(
+            f"file geometry inconsistent with dim={dim}: "
+            f"size_per={size_per}, offset_data={offset_data}"
+        )
+    # hnswlib packs the link count as uint16 with flags (delete mark) in the
+    # upper bytes of the 4-byte field — reading int32 would corrupt counts
+    # for marked-deleted elements
+    counts = level0[:, 0:2].copy().view(np.uint16)[:, 0].astype(np.int64)
+    links = level0[:, 4 : 4 + deg * 4].copy().view(np.uint32).reshape(n, deg)
+    data = level0[:, offset_data : offset_data + dim * 4].copy().view(np.float32)
+    data = data.reshape(n, dim)
+    labels = level0[:, label_off:].copy().view(np.uint64)[:, 0].astype(np.int64)
+    # mask unused link slots with self (valid, harmless for beam search)
+    slot = np.arange(deg)[None, :]
+    self_col = np.arange(n, dtype=np.uint32)[:, None]
+    links = np.where(slot < counts[:, None], links, self_col)
+    # order by labels so row id == label
+    order = np.argsort(labels)
+    inv = np.empty(n, np.int64)
+    inv[order] = np.arange(n)
+    data = data[order]
+    links = inv[links.astype(np.int64)][order].astype(np.int32)
+    return cagra.from_graph(metric, jnp.asarray(data), jnp.asarray(links))
+
+
+def search(
+    index: "cagra.Index",
+    queries: jax.Array,
+    k: int,
+    *,
+    ef: int = 64,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search an hnsw-loaded (or any CAGRA) index; ``ef`` maps to the beam
+    width (ref: hnsw.hpp search_params{ef})."""
+    params = cagra.SearchParams(itopk_size=max(ef, k))
+    return cagra.search(params, index, queries, k, res=res)

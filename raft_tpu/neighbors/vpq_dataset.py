@@ -1,0 +1,149 @@
+"""VPQ (vector-product-quantized) compressed datasets.
+
+Reference: ``neighbors/vpq_dataset.cuh`` / ``detail/vpq_dataset.cuh`` — a
+two-level compression for CAGRA datasets: coarse vector quantization
+(vq_n_centers Lloyd centers) plus product quantization of the residuals;
+CAGRA search then computes distances against decoded codes
+(``detail/cagra/compute_distance_vpq.cuh``). Params mirror
+``neighbors/dataset.hpp:37-259`` vpq_params.
+
+TPU re-design: codes are stored unpacked (one byte per sub-quantizer, int32
+per VQ id) so decode is pure gathers: row = vq_center[vq_code] +
+concat_j codebook[j, pq_code_j] — exactly the shape the beam search's
+candidate gather wants. Training reuses the batched-Lloyd codebook trainer
+from ivf_pq (one compiled program trains all subspaces)."""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.distance.pairwise import _PREC
+from raft_tpu.neighbors.ivf_pq import _train_codebooks_lloyd
+
+
+@dataclass
+class VpqParams:
+    """(ref: neighbors/dataset.hpp vpq_params)"""
+
+    vq_n_centers: int = 0      # 0 → auto (~√n, clipped)
+    pq_dim: int = 0            # 0 → auto (dim/2 for vpq)
+    pq_bits: int = 8
+    kmeans_n_iters: int = 25
+    vq_kmeans_trainset_fraction: float = 1.0
+    pq_kmeans_trainset_fraction: float = 1.0
+    seed: int = 0
+
+
+@jax.tree_util.register_pytree_node_class
+class VpqDataset:
+    """Compressed dataset: decode(ids) reproduces rows approximately."""
+
+    def __init__(self, vq_centers, pq_codebook, vq_codes, pq_codes, dim: int):
+        self.vq_centers = vq_centers    # [V, dim]
+        self.pq_codebook = pq_codebook  # [pq_dim, 2**bits, pq_len]
+        self.vq_codes = vq_codes        # [n] int32
+        self.pq_codes = pq_codes        # [n, pq_dim] uint8
+        self.dim = dim
+
+    def tree_flatten(self):
+        return (self.vq_centers, self.pq_codebook, self.vq_codes, self.pq_codes), (self.dim,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.vq_codes.shape[0], self.dim)
+
+    @property
+    def pq_dim(self) -> int:
+        return self.pq_codes.shape[1]
+
+    @property
+    def pq_len(self) -> int:
+        return self.pq_codebook.shape[2]
+
+    def decode(self, ids: jax.Array) -> jax.Array:
+        """Decoded rows for arbitrary id tensors: [..., dim]
+        (ref: compute_distance_vpq.cuh decodes inside the distance kernel)."""
+        n = self.vq_codes.shape[0]
+        safe = jnp.clip(ids, 0, n - 1)
+        base = self.vq_centers[self.vq_codes[safe]]             # [..., dim]
+        codes = self.pq_codes[safe].astype(jnp.int32)           # [..., pq_dim]
+        j = jnp.arange(self.pq_dim)
+        resid = self.pq_codebook[j, codes]                      # [..., pq_dim, pq_len]
+        resid = resid.reshape(resid.shape[:-2] + (self.pq_dim * self.pq_len,))
+        return base + resid[..., : self.dim]
+
+
+def _auto_vq_centers(n: int) -> int:
+    return int(np.clip(int(np.sqrt(n)), 16, 1 << 16))
+
+
+def build(
+    params: VpqParams,
+    dataset: jax.Array,
+    *,
+    res: Optional[Resources] = None,
+) -> VpqDataset:
+    """Train VQ + PQ and encode the dataset
+    (ref: detail/vpq_dataset.cuh vpq_build: train_vq → train_pq → process)."""
+    res = ensure(res)
+    if not (4 <= params.pq_bits <= 8):
+        # codes are stored one byte per sub-quantizer (ref vpq_params caps
+        # pq_bits at 8 too); >8 would silently wrap in the uint8 cast
+        raise ValueError(f"pq_bits must be in [4, 8], got {params.pq_bits}")
+    x = jnp.asarray(dataset, jnp.float32)
+    n, dim = x.shape
+    V = params.vq_n_centers or _auto_vq_centers(n)
+    pq_dim = params.pq_dim or max(1, dim // 2)
+    pq_len = max(1, (dim + pq_dim - 1) // pq_dim)
+    pad = pq_dim * pq_len - dim
+    key = jax.random.PRNGKey(params.seed)
+    k_vq, k_pq = jax.random.split(key)
+
+    # --- coarse VQ (balanced kmeans, like the IVF coarse quantizers)
+    frac = params.vq_kmeans_trainset_fraction
+    n_train = min(n, max(V * 4, int(n * frac)))
+    train = x if n_train >= n else x[
+        jax.random.choice(k_vq, n, shape=(n_train,), replace=False)
+    ]
+    kb = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, seed=params.seed
+    )
+    vq_centers = kmeans_balanced.fit(kb, train, V, res=res)
+    vq_codes = kmeans_balanced.predict(vq_centers, x, res=res)
+
+    # --- PQ on residuals (zero-pad dim up to pq_dim*pq_len)
+    resid = x - vq_centers[vq_codes]
+    if pad:
+        resid = jnp.pad(resid, ((0, 0), (0, pad)))
+    sub = jnp.transpose(resid.reshape(n, pq_dim, pq_len), (1, 0, 2))
+    codebook = _train_codebooks_lloyd(
+        k_pq, sub, 1 << params.pq_bits, params.kmeans_n_iters
+    )
+
+    # --- encode
+    ip = jnp.einsum("njl,jkl->njk", resid.reshape(n, pq_dim, pq_len),
+                    codebook, precision=_PREC)
+    cb2 = jnp.sum(codebook * codebook, axis=2)
+    pq_codes = jnp.argmin(cb2[None] - 2.0 * ip, axis=2).astype(jnp.uint8)
+    return VpqDataset(vq_centers, codebook, vq_codes, pq_codes, dim)
+
+
+def compression_ratio(ds: VpqDataset) -> float:
+    """Bytes of f32 rows / bytes of codes (codebooks excluded, like the
+    reference's storage accounting)."""
+    n, dim = ds.shape
+    raw = n * dim * 4
+    packed = n * (4 + ds.pq_dim)
+    return raw / packed

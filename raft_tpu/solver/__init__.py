@@ -1,0 +1,5 @@
+"""Combinatorial solvers (ref: cpp/include/raft/solver/)."""
+
+from raft_tpu.solver.linear_assignment import linear_assignment
+
+__all__ = ["linear_assignment"]

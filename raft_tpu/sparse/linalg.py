@@ -102,12 +102,54 @@ def symmetrize(coo: COO, *, op: str = "max") -> COO:
     return _reduce_duplicates(both, op)
 
 
+def laplacian(adj: COO, *, normalized: bool = False) -> COO:
+    """Graph Laplacian L = D − A (or normalized I − D^-½AD^-½) as COO
+    (ref: spectral pipelines build this before the Lanczos solve,
+    spectral/matrix_wrappers.hpp laplacian_matrix_t)."""
+    n = adj.shape[0]
+    assert adj.shape[0] == adj.shape[1]
+    deg_w = weighted_degree(adj)
+    diag_r = jnp.arange(n, dtype=jnp.int32)
+    if normalized:
+        inv_sqrt = jnp.where(deg_w > 0, 1.0 / jnp.sqrt(jnp.maximum(deg_w, 1e-30)), 0.0)
+        off = -adj.data * inv_sqrt[jnp.clip(adj.rows, 0, n - 1)] * inv_sqrt[
+            jnp.clip(adj.cols, 0, n - 1)
+        ]
+        diag_v = jnp.where(deg_w > 0, 1.0, 0.0)
+    else:
+        off = -adj.data
+        diag_v = deg_w
+    rows = jnp.concatenate([adj.rows, diag_r])
+    cols = jnp.concatenate([adj.cols, diag_r])
+    data = jnp.concatenate([jnp.where(adj.valid, off, 0), diag_v])
+    live = jnp.concatenate([adj.valid, jnp.ones(n, bool)])
+    order = jnp.argsort(~live, stable=True)
+    return COO(rows[order], cols[order], data[order], adj.shape, adj.nnz + n)
+
+
+def spmv_coo(coo: COO, x: jax.Array) -> jax.Array:
+    """COO matrix-vector product (edge-parallel segment_sum)."""
+    n = coo.shape[0]
+    contrib = jnp.where(coo.valid, coo.data * x[jnp.clip(coo.cols, 0, n - 1)], 0)
+    return jax.ops.segment_sum(
+        contrib, jnp.where(coo.valid, coo.rows, n), num_segments=n + 1
+    )[:n]
+
+
 def degree(coo: COO) -> jax.Array:
     """Per-row nonzero count (ref: sparse/linalg/degree.cuh)."""
     n = coo.shape[0]
     return jnp.zeros(n, jnp.int32).at[
         jnp.where(coo.valid, coo.rows, n)
     ].add(jnp.where(coo.valid, 1, 0), mode="drop")
+
+
+def weighted_degree(coo: COO) -> jax.Array:
+    """Per-row sum of edge weights (the d vector of spectral methods)."""
+    n = coo.shape[0]
+    return jnp.zeros(n, coo.data.dtype).at[
+        jnp.where(coo.valid, coo.rows, n)
+    ].add(jnp.where(coo.valid, coo.data, 0), mode="drop")
 
 
 def row_norm_csr(csr: CSR, *, norm_type: str = "l2") -> jax.Array:

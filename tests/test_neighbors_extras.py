@@ -1,0 +1,234 @@
+"""Ball cover, epsilon neighborhood, masked NN, batch-k query, HNSW export,
+VPQ compression, LAP (mirrors cpp/test/neighbors/{ball_cover,
+epsilon_neighborhood}.cu, cpp/test/distance/masked_nn.cu, cpp/test/lap/,
+cpp/test/neighbors/ann_cagra_vpq/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import (
+    BatchKQuery,
+    ball_cover,
+    brute_force,
+    cagra,
+    epsilon_neighborhood,
+    hnsw,
+    masked_l2_nn,
+    vpq_dataset,
+)
+from raft_tpu.solver import linear_assignment
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    x = rng.random((3000, 16), dtype=np.float32)
+    q = rng.random((40, 16), dtype=np.float32)
+    return x, q
+
+
+# ---------------- ball cover ----------------
+
+def test_ball_cover_exact_when_probing_all(data):
+    x, q = data
+    idx = ball_cover.build(x, n_landmarks=50)
+    _, gt = brute_force.knn(x, q, 10)
+    _, got = ball_cover.knn_query(idx, q, 10, n_probes=50)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(gt))
+
+
+def test_ball_cover_approx_recall(data):
+    x, q = data
+    idx = ball_cover.build(x)
+    _, gt = brute_force.knn(x, q, 10)
+    _, got = ball_cover.knn_query(idx, q, 10)
+    r = float(neighborhood_recall(np.asarray(got), np.asarray(gt)))
+    assert r >= 0.9, r
+
+
+def test_ball_cover_all_knn(data):
+    x, _ = data
+    idx = ball_cover.build(x[:500], n_landmarks=22)
+    d, i = ball_cover.all_knn_query(idx, 5, n_probes=22)
+    # row i's nearest neighbor is itself at distance 0
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(500))
+
+
+def test_ball_cover_haversine():
+    rng = np.random.default_rng(1)
+    pts = np.stack([
+        rng.uniform(-np.pi / 2, np.pi / 2, 400),
+        rng.uniform(-np.pi, np.pi, 400),
+    ], axis=1).astype(np.float32)
+    q = pts[:15] + 0.01
+    idx = ball_cover.build(pts, metric="haversine", n_landmarks=20)
+    d, i = ball_cover.knn_query(idx, q, 5, n_probes=20)
+    # reference haversine
+    def hav(a, b):
+        sdlat = np.sin((b[:, 0] - a[:, None, 0]) / 2)
+        sdlon = np.sin((b[:, 1] - a[:, None, 1]) / 2)
+        h = sdlat**2 + np.cos(a[:, None, 0]) * np.cos(b[:, 0]) * sdlon**2
+        return 2 * np.arcsin(np.sqrt(np.clip(h, 0, 1)))
+    gt = np.argsort(hav(q, pts), axis=1)[:, :5]
+    r = float(neighborhood_recall(np.asarray(i), gt))
+    assert r >= 0.95, r
+
+
+def test_ball_cover_eps_nn(data):
+    x, q = data
+    x = x[:400]
+    idx = ball_cover.build(x, n_landmarks=20)
+    eps = 0.3
+    adj, deg = ball_cover.eps_nn(idx, q, eps)
+    d = ((q[:, None] - x[None, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(adj), d <= eps)
+    np.testing.assert_array_equal(np.asarray(deg), (d <= eps).sum(1))
+
+
+def test_ball_cover_eps_nn_euclidean_metric(data):
+    """eps is interpreted in the index metric (regression: euclidean eps was
+    compared against squared distances)."""
+    x, q = data
+    x = x[:300]
+    idx = ball_cover.build(x, metric="euclidean", n_landmarks=15)
+    eps = 0.8
+    adj, _ = ball_cover.eps_nn(idx, q, eps)
+    d = np.sqrt(((q[:, None] - x[None, :]) ** 2).sum(-1))
+    np.testing.assert_array_equal(np.asarray(adj), d <= eps)
+
+
+def test_vpq_rejects_bad_pq_bits(data):
+    x, _ = data
+    with pytest.raises(ValueError):
+        vpq_dataset.build(vpq_dataset.VpqParams(pq_bits=9), x[:100])
+
+
+# ---------------- epsilon neighborhood / masked nn ----------------
+
+def test_epsilon_neighborhood(data):
+    x, q = data
+    adj, deg = epsilon_neighborhood(q, x[:500], 0.4)
+    d = ((q[:, None] - x[None, :500]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(adj), d <= 0.4)
+    np.testing.assert_array_equal(np.asarray(deg), (d <= 0.4).sum(1))
+
+
+def test_masked_l2_nn():
+    rng = np.random.default_rng(2)
+    x = rng.random((30, 8)).astype(np.float32)
+    y = rng.random((40, 8)).astype(np.float32)
+    # 4 contiguous groups of 10
+    group_ends = jnp.asarray([10, 20, 30, 40])
+    adj = rng.random((30, 4)) > 0.4
+    adj[0] = False  # row with nothing admissible
+    v, j = masked_l2_nn(jnp.asarray(x), jnp.asarray(y), jnp.asarray(adj), group_ends)
+    d = ((x[:, None] - y[None, :]) ** 2).sum(-1)
+    gid = np.repeat(np.arange(4), 10)
+    allowed = adj[:, gid]
+    d_masked = np.where(allowed, d, np.inf)
+    ref_j = np.where(allowed.any(1), d_masked.argmin(1), -1)
+    np.testing.assert_array_equal(np.asarray(j), ref_j)
+    assert np.asarray(j)[0] == -1
+
+
+# ---------------- batch-k query ----------------
+
+def test_batch_k_query(data):
+    x, q = data
+    x = x[:200]
+    bq = BatchKQuery(x, q, batch_size=16)
+    _, gt = brute_force.knn(x, q, 64)
+    got_ids = []
+    for bi, (v, i) in enumerate(iter(bq)):
+        got_ids.append(np.asarray(i))
+        if bi == 3:
+            break
+    got = np.concatenate(got_ids, axis=1)
+    np.testing.assert_array_equal(got, np.asarray(gt))
+
+
+# ---------------- hnsw export ----------------
+
+def test_hnsw_roundtrip(tmp_path, data):
+    x, q = data
+    x = x[:1500]
+    params = cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16, build_algo="brute_force"
+    )
+    index = cagra.build(params, x)
+    fn = str(tmp_path / "index.hnsw")
+    hnsw.serialize_to_hnswlib(fn, index)
+    loaded = hnsw.load(fn, dim=x.shape[1])
+    # dataset and graph survive the round trip exactly
+    np.testing.assert_allclose(np.asarray(loaded.dataset), x, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(loaded.graph), np.asarray(index.graph))
+    _, gt = brute_force.knn(x, q, 5)
+    _, i = hnsw.search(loaded, q, 5, ef=64)
+    r = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert r >= 0.85, r
+
+
+def test_hnsw_format_geometry(tmp_path, data):
+    """Header fields follow hnswlib's saveIndex layout byte-for-byte."""
+    import struct
+
+    x, _ = data
+    x = x[:64]
+    index = cagra.build(
+        cagra.IndexParams(intermediate_graph_degree=16, graph_degree=8,
+                          build_algo="brute_force"), x)
+    fn = str(tmp_path / "geom.hnsw")
+    hnsw.serialize_to_hnswlib(fn, index)
+    raw = open(fn, "rb").read()
+    off0, max_el, cur, size_per, label_off, off_data = struct.unpack("<6Q", raw[:48])
+    assert (off0, max_el, cur) == (0, 64, 64)
+    assert size_per == 8 * 4 + 4 + 16 * 4 + 8
+    assert label_off == size_per - 8 and off_data == 8 * 4 + 4
+    expected = 48 + 8 + 3 * 8 + 8 + 8 + 64 * size_per + 64 * 4
+    assert len(raw) == expected
+
+
+# ---------------- vpq ----------------
+
+def test_vpq_compression_and_search(data):
+    x, q = data
+    params = cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16, build_algo="brute_force"
+    )
+    index = cagra.build(params, x)
+    comp = cagra.compress(
+        index, vpq_dataset.VpqParams(vq_n_centers=64, pq_dim=8, pq_bits=8)
+    )
+    assert vpq_dataset.compression_ratio(comp.dataset) > 4.0
+    # decode error is bounded (residual PQ on top of VQ)
+    dec = np.asarray(comp.dataset.decode(jnp.arange(200)))
+    err = np.abs(dec - x[:200]).mean()
+    assert err < 0.1, err
+    _, gt = brute_force.knn(x, q, 10)
+    _, i = cagra.search(cagra.SearchParams(itopk_size=96), comp, q, 10)
+    r = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert r >= 0.7, r  # compressed-distance search trades recall for memory
+
+
+# ---------------- lap ----------------
+
+def test_linear_assignment_vs_scipy():
+    from scipy.optimize import linear_sum_assignment
+
+    rng = np.random.default_rng(3)
+    for n in (8, 32):
+        c = rng.random((n, n)).astype(np.float32)
+        ours, total = linear_assignment(c)
+        ours = np.asarray(ours)
+        assert sorted(ours.tolist()) == list(range(n))
+        r, col = linear_sum_assignment(c)
+        np.testing.assert_allclose(float(total), c[r, col].sum(), atol=1e-4)
+    # maximize mode
+    c = rng.random((16, 16)).astype(np.float32)
+    _, tmax = linear_assignment(c, maximize=True)
+    r, col = linear_sum_assignment(c, maximize=True)
+    np.testing.assert_allclose(float(tmax), c[r, col].sum(), atol=1e-4)

@@ -1,0 +1,98 @@
+"""Lanczos eigensolver + spectral partition/modularity + auto find_k
+(mirrors cpp/test/linalg/eigen_solvers.cu + cpp/test/cluster/
+kmeans_find_k.cu + spectral suites)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.cluster import find_k, spectral
+from raft_tpu.ops.lanczos import eigsh_lanczos
+from raft_tpu.random import make_blobs
+from raft_tpu.sparse import COO
+from raft_tpu.sparse.linalg import laplacian, spmv_coo
+from raft_tpu.sparse.neighbors import knn_graph
+from raft_tpu.stats import adjusted_rand_index
+
+
+def test_lanczos_dense_symmetric(rng):
+    n = 60
+    a = rng.random((n, n)).astype(np.float32)
+    a = (a + a.T) / 2
+    aj = jnp.asarray(a)
+    vals, vecs = eigsh_lanczos(lambda v: aj @ v, n, 5, which="smallest", m=n)
+    ref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.asarray(vals), ref[:5], rtol=1e-3, atol=1e-3)
+    vals_l, _ = eigsh_lanczos(lambda v: aj @ v, n, 3, which="largest", m=n)
+    np.testing.assert_allclose(np.asarray(vals_l), ref[-3:], rtol=1e-3, atol=1e-3)
+    # eigenvector residual ‖Av − λv‖ small
+    v0 = np.asarray(vecs[:, 0])
+    np.testing.assert_allclose(a @ v0, float(vals[0]) * v0, atol=5e-3)
+
+
+def test_laplacian_and_spmv():
+    # triangle graph 0-1-2 + isolated 3
+    rows = np.array([0, 1, 1, 2, 0, 2], np.int32)
+    cols = np.array([1, 0, 2, 1, 2, 0], np.int32)
+    adj = COO(rows, cols, np.ones(6, np.float32), (4, 4))
+    lap = laplacian(adj)
+    dense = np.asarray(lap.to_dense())
+    want = np.array(
+        [[2, -1, -1, 0], [-1, 2, -1, 0], [-1, -1, 2, 0], [0, 0, 0, 0]],
+        np.float32,
+    )
+    np.testing.assert_allclose(dense, want)
+    x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    np.testing.assert_allclose(np.asarray(spmv_coo(lap, jnp.asarray(x))), want @ x)
+    # normalized Laplacian has unit diagonal on connected rows
+    lapn = np.asarray(laplacian(adj, normalized=True).to_dense())
+    np.testing.assert_allclose(np.diag(lapn), [1, 1, 1, 0])
+
+
+def test_spectral_partition_two_cliques():
+    # two 10-cliques joined by one weak edge → perfect 2-partition
+    n = 20
+    rows, cols = [], []
+    for base in (0, 10):
+        for i in range(10):
+            for j in range(10):
+                if i != j:
+                    rows.append(base + i)
+                    cols.append(base + j)
+    rows += [0, 10]
+    cols += [10, 0]
+    adj = COO(np.asarray(rows, np.int32), np.asarray(cols, np.int32),
+              np.ones(len(rows), np.float32), (n, n))
+    labels, vals = spectral.partition(adj, 2, seed=1)
+    labels = np.asarray(labels)
+    truth = np.array([0] * 10 + [1] * 10)
+    ari = float(adjusted_rand_index(jnp.asarray(labels), jnp.asarray(truth)))
+    assert ari == 1.0, (labels, ari)
+    cut, min_size = spectral.analyze_partition(adj, jnp.asarray(labels), 2)
+    assert float(cut) == 1.0  # exactly the single weak edge
+    assert int(min_size) == 10
+
+
+def test_modularity_maximization_blobs():
+    key = jax.random.PRNGKey(0)
+    x, truth, _ = make_blobs(key, 200, 6, n_clusters=3, cluster_std=0.4)
+    adj = knn_graph(np.asarray(x), 8)
+    # similarity weights (invert distances) for modularity
+    sim = COO(adj.rows, adj.cols,
+              jnp.where(adj.valid, 1.0 / (1.0 + adj.data), 0.0),
+              adj.shape, adj.nnz)
+    labels, _ = spectral.modularity_maximization(sim, 3, seed=0)
+    ari = float(adjusted_rand_index(labels, truth))
+    assert ari > 0.9, ari
+    q = float(spectral.analyze_modularity(sim, labels))
+    assert q > 0.5, q
+
+
+def test_find_k_blobs():
+    key = jax.random.PRNGKey(2)
+    x, _, _ = make_blobs(key, 400, 4, n_clusters=5, cluster_std=0.3)
+    k, centers, inertia = find_k(np.asarray(x), kmax=10, kmin=1)
+    assert 4 <= k <= 6, k
+    assert centers.shape[1] == 4
