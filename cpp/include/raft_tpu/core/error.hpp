@@ -1,0 +1,23 @@
+// Error handling for the native core (ref: cpp/include/raft/core/error.hpp —
+// raft::exception + RAFT_EXPECTS/RAFT_FAIL macros; re-expressed for the TPU
+// runtime: no CUDA_TRY family, errors cross the C ABI as codes + messages).
+#pragma once
+
+#include <stdexcept>
+#include <string>
+
+namespace raft_tpu {
+
+class exception : public std::runtime_error {
+ public:
+  explicit exception(const std::string& what) : std::runtime_error(what) {}
+};
+
+}  // namespace raft_tpu
+
+#define RAFT_TPU_EXPECTS(cond, msg)                   \
+  do {                                                \
+    if (!(cond)) throw ::raft_tpu::exception(msg);    \
+  } while (0)
+
+#define RAFT_TPU_FAIL(msg) throw ::raft_tpu::exception(msg)

@@ -1,0 +1,60 @@
+// Cooperative cross-thread cancellation.
+//
+// Mirrors the reference's interruptible (core/interruptible.hpp:41-96): a
+// per-thread token that long-running host loops poll via check(); another
+// thread cancels by token. The reference hooks this into stream syncs; the
+// TPU runtime polls it between batch dispatches (block_until_ready chunks).
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "raft_tpu/core/error.hpp"
+
+namespace raft_tpu {
+
+class interruptible {
+ public:
+  // token for the calling thread (created on first use)
+  static std::shared_ptr<interruptible> get_token() {
+    return get_token_for(std::this_thread::get_id());
+  }
+
+  static std::shared_ptr<interruptible> get_token_for(std::thread::id tid) {
+    std::lock_guard<std::mutex> lk(registry_mu());
+    auto& slot = registry()[tid];
+    if (!slot) slot = std::make_shared<interruptible>();
+    return slot;
+  }
+
+  void cancel() { cancelled_.store(true, std::memory_order_relaxed); }
+
+  bool cancelled() const {
+    return cancelled_.load(std::memory_order_relaxed);
+  }
+
+  // throws and clears the flag if cancelled (the reference's
+  // interruptible::check_interruptible behavior)
+  void check() {
+    if (cancelled_.exchange(false, std::memory_order_relaxed)) {
+      RAFT_TPU_FAIL("interrupted");
+    }
+  }
+
+ private:
+  static std::mutex& registry_mu() {
+    static std::mutex mu;
+    return mu;
+  }
+  static std::unordered_map<std::thread::id, std::shared_ptr<interruptible>>&
+  registry() {
+    static std::unordered_map<std::thread::id, std::shared_ptr<interruptible>> r;
+    return r;
+  }
+  std::atomic<bool> cancelled_{false};
+};
+
+}  // namespace raft_tpu

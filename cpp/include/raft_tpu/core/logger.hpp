@@ -1,0 +1,73 @@
+// Leveled logger with callback sink.
+//
+// Mirrors the reference's logger surface (core/logger-inl.hpp:72-110: a
+// process singleton with runtime level control and a callback sink used for
+// Python flush integration, core/detail/callback_sink.hpp) without the
+// spdlog dependency — the TPU runtime only needs leveled printf-style
+// logging plus the callback hook.
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <functional>
+#include <mutex>
+#include <string>
+
+namespace raft_tpu {
+
+enum class log_level : int { off = 0, error, warn, info, debug, trace };
+
+class logger {
+ public:
+  using callback_t = void (*)(int level, const char* msg, void* user);
+
+  static logger& get() {
+    static logger inst;
+    return inst;
+  }
+
+  void set_level(log_level lvl) { level_ = lvl; }
+  log_level level() const { return level_; }
+
+  void set_callback(callback_t cb, void* user) {
+    std::lock_guard<std::mutex> lk(mu_);
+    cb_ = cb;
+    user_ = user;
+  }
+
+  void set_pattern(const std::string& p) { pattern_ = p; }
+
+  void log(log_level lvl, const char* fmt, ...) {
+    if (static_cast<int>(lvl) > static_cast<int>(level_)) return;
+    char buf[2048];
+    va_list args;
+    va_start(args, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, args);
+    va_end(args);
+    std::lock_guard<std::mutex> lk(mu_);
+    if (cb_) {
+      cb_(static_cast<int>(lvl), buf, user_);
+    } else {
+      std::fprintf(stderr, "[raft_tpu][%d] %s\n", static_cast<int>(lvl), buf);
+    }
+  }
+
+ private:
+  logger() = default;
+  std::mutex mu_;
+  log_level level_ = log_level::info;
+  callback_t cb_ = nullptr;
+  void* user_ = nullptr;
+  std::string pattern_;
+};
+
+}  // namespace raft_tpu
+
+#define RAFT_TPU_LOG_INFO(...) \
+  ::raft_tpu::logger::get().log(::raft_tpu::log_level::info, __VA_ARGS__)
+#define RAFT_TPU_LOG_WARN(...) \
+  ::raft_tpu::logger::get().log(::raft_tpu::log_level::warn, __VA_ARGS__)
+#define RAFT_TPU_LOG_ERROR(...) \
+  ::raft_tpu::logger::get().log(::raft_tpu::log_level::error, __VA_ARGS__)
+#define RAFT_TPU_LOG_DEBUG(...) \
+  ::raft_tpu::logger::get().log(::raft_tpu::log_level::debug, __VA_ARGS__)

@@ -1,0 +1,85 @@
+// Host mdarray: owning n-dimensional row-major array with dtype tags.
+//
+// The reference's mdarray family (core/mdarray.hpp:103-128 + host/device
+// variants + accessor-tagged memory types, core/memory_type.hpp:30-56) is a
+// C++ view/owner system over device memory. On TPU the device side is XLA
+// buffers; the native runtime needs the *host* counterpart for staging,
+// serialization and IO, with the same memory-type tagging so a future PJRT
+// path can add device/pinned spaces behind the same type.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+#include "raft_tpu/core/error.hpp"
+
+namespace raft_tpu {
+
+enum class memory_type : int { host = 0, pinned = 1, device = 2, managed = 3 };
+
+enum class dtype : int {
+  f32 = 0,
+  f64,
+  i8,
+  u8,
+  i32,
+  i64,
+  u32,
+  f16,   // stored as uint16 payload host-side
+  bf16,  // stored as uint16 payload host-side
+};
+
+inline std::size_t dtype_size(dtype t) {
+  switch (t) {
+    case dtype::f64: case dtype::i64: return 8;
+    case dtype::f32: case dtype::i32: case dtype::u32: return 4;
+    case dtype::f16: case dtype::bf16: return 2;
+    default: return 1;
+  }
+}
+
+class mdarray {
+ public:
+  mdarray() : dtype_(dtype::f32), mem_(memory_type::host) {}
+
+  mdarray(std::vector<std::int64_t> shape, dtype dt,
+          memory_type mem = memory_type::host)
+      : shape_(std::move(shape)), dtype_(dt), mem_(mem) {
+    RAFT_TPU_EXPECTS(mem == memory_type::host || mem == memory_type::pinned,
+                     "native mdarray owns host-accessible memory only");
+    data_.resize(size_bytes());
+  }
+
+  const std::vector<std::int64_t>& shape() const { return shape_; }
+  std::int64_t extent(int i) const { return shape_.at(i); }
+  int rank() const { return static_cast<int>(shape_.size()); }
+  dtype type() const { return dtype_; }
+  memory_type mem() const { return mem_; }
+
+  std::int64_t size() const {
+    std::int64_t n = 1;
+    for (auto e : shape_) n *= e;
+    return n;
+  }
+  std::size_t size_bytes() const {
+    return static_cast<std::size_t>(size()) * dtype_size(dtype_);
+  }
+
+  void* data() { return data_.data(); }
+  const void* data() const { return data_.data(); }
+
+  template <typename T>
+  T* data_as() { return reinterpret_cast<T*>(data_.data()); }
+  template <typename T>
+  const T* data_as() const { return reinterpret_cast<const T*>(data_.data()); }
+
+ private:
+  std::vector<std::int64_t> shape_;
+  dtype dtype_;
+  memory_type mem_;
+  std::vector<std::uint8_t> data_;
+};
+
+}  // namespace raft_tpu

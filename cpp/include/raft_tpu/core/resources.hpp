@@ -1,0 +1,90 @@
+// Resource registry: lazy, factory-keyed, shallow-copyable.
+//
+// Mirrors the semantics of the reference's resource container
+// (cpp/include/raft/core/resources.hpp:49-138: resources hold a vector of
+// (type, factory) pairs; get_resource instantiates on first touch) with the
+// TPU runtime's resource kinds (core/resource/resource_types.hpp:29-50 lists
+// the reference's enum — stream/cublas/... become workspace arena, logger,
+// PRNG seed, device/mesh descriptors, communicator handle here).
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "raft_tpu/core/error.hpp"
+
+namespace raft_tpu {
+
+// resource kinds of the TPU runtime (analog of resource_types.hpp)
+enum class resource_type : int {
+  workspace = 0,       // host workspace arena
+  large_workspace,     // spill arena for batch buffers
+  logger,              // logger sink
+  rng_seed,            // root PRNG seed
+  device,              // device descriptor (ordinal, platform)
+  mesh,                // mesh descriptor (shape, axis names)
+  communicator,        // comms handle
+  custom0,
+  custom1,
+  count_,
+};
+
+struct resource {
+  virtual ~resource() = default;
+  virtual void* get() = 0;
+};
+
+struct resource_factory {
+  virtual ~resource_factory() = default;
+  virtual resource_type type() const = 0;
+  virtual std::unique_ptr<resource> make() const = 0;
+};
+
+// Shallow-copyable: copies share instantiated resources (the reference's
+// resources are likewise cheaply copyable views over shared factories).
+class resources {
+ public:
+  resources() : state_{std::make_shared<state>()} {}
+  resources(const resources&) = default;
+  resources& operator=(const resources&) = default;
+
+  void add_resource_factory(std::shared_ptr<resource_factory> factory) {
+    std::lock_guard<std::mutex> lk(state_->mu);
+    auto t = static_cast<int>(factory->type());
+    state_->factories[t] = std::move(factory);
+    state_->instances.erase(t);  // re-created on next touch
+  }
+
+  bool has_resource_factory(resource_type t) const {
+    std::lock_guard<std::mutex> lk(state_->mu);
+    return state_->factories.count(static_cast<int>(t)) != 0;
+  }
+
+  // Lazily instantiate + fetch. Typed accessors wrap this.
+  void* get_resource(resource_type t) const {
+    std::lock_guard<std::mutex> lk(state_->mu);
+    auto ti = static_cast<int>(t);
+    auto it = state_->instances.find(ti);
+    if (it == state_->instances.end()) {
+      auto fit = state_->factories.find(ti);
+      RAFT_TPU_EXPECTS(fit != state_->factories.end(),
+                       "no factory registered for resource type");
+      it = state_->instances.emplace(ti, fit->second->make()).first;
+    }
+    return it->second->get();
+  }
+
+ private:
+  struct state {
+    mutable std::mutex mu;
+    std::unordered_map<int, std::shared_ptr<resource_factory>> factories;
+    std::unordered_map<int, std::unique_ptr<resource>> instances;
+  };
+  std::shared_ptr<state> state_;
+};
+
+}  // namespace raft_tpu

@@ -1,0 +1,23 @@
+// NumPy .npy (de)serialization of mdarrays.
+//
+// The reference serializes every index through an .npy-format mdspan writer
+// (core/serialize.hpp:36-122, core/detail/mdspan_numpy_serializer.hpp) so
+// checkpoints interoperate with numpy. Same wire format here: magic
+// "\x93NUMPY", version 1.0, python-dict header padded to 64B, row-major
+// little-endian payload.
+#pragma once
+
+#include <iosfwd>
+
+#include "raft_tpu/core/mdarray.hpp"
+
+namespace raft_tpu {
+
+void serialize_mdarray(std::ostream& os, const mdarray& arr);
+mdarray deserialize_mdarray(std::istream& is);
+
+// scalar framing used by index files (version-stamped headers)
+void serialize_scalar_i64(std::ostream& os, std::int64_t v);
+std::int64_t deserialize_scalar_i64(std::istream& is);
+
+}  // namespace raft_tpu

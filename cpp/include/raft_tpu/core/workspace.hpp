@@ -1,0 +1,74 @@
+// Host workspace arena: bounded bump allocator with high-water stats.
+//
+// The reference's workspace resources are RMM pool/limiting adaptors hung on
+// the handle (core/resource/workspace_resource.hpp, limiting_resource_adaptor)
+// so algorithms can grab scratch without hitting the system allocator; the
+// TPU runtime's device scratch lives inside XLA, so the native arena covers
+// the host side: staging buffers for serialization, packing, and IO.
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+#include "raft_tpu/core/error.hpp"
+
+namespace raft_tpu {
+
+class workspace_arena {
+ public:
+  explicit workspace_arena(std::size_t limit_bytes)
+      : limit_(limit_bytes), used_(0), high_water_(0) {}
+
+  void* allocate(std::size_t bytes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    bytes = (bytes + 63) & ~std::size_t{63};  // 64B alignment quantum
+    RAFT_TPU_EXPECTS(used_ + bytes <= limit_,
+                     "workspace arena limit exceeded");
+    auto* p = new (std::nothrow) std::uint8_t[bytes];
+    RAFT_TPU_EXPECTS(p != nullptr, "workspace allocation failed");
+    used_ += bytes;
+    if (used_ > high_water_) high_water_ = used_;
+    blocks_.push_back({p, bytes});
+    return p;
+  }
+
+  void deallocate(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto it = blocks_.begin(); it != blocks_.end(); ++it) {
+      if (it->ptr == p) {
+        used_ -= it->bytes;
+        delete[] it->ptr;
+        blocks_.erase(it);
+        return;
+      }
+    }
+    RAFT_TPU_FAIL("deallocate of unknown workspace pointer");
+  }
+
+  void release_all() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& b : blocks_) delete[] b.ptr;
+    blocks_.clear();
+    used_ = 0;
+  }
+
+  std::size_t used() const { return used_; }
+  std::size_t high_water() const { return high_water_; }
+  std::size_t limit() const { return limit_; }
+
+  ~workspace_arena() { release_all(); }
+
+ private:
+  struct block {
+    std::uint8_t* ptr;
+    std::size_t bytes;
+  };
+  std::mutex mu_;
+  std::size_t limit_, used_, high_water_;
+  std::vector<block> blocks_;
+};
+
+}  // namespace raft_tpu

@@ -1,0 +1,186 @@
+// C ABI over the native core — the stable, non-templated entry layer the
+// Python package binds with ctypes (ref: the raft_runtime layer,
+// cpp/include/raft_runtime/ — same role: no templates across the boundary,
+// plain handles + error codes).
+#include <cstring>
+#include <fstream>
+#include <new>
+#include <string>
+
+#include "raft_tpu/core/interruptible.hpp"
+#include "raft_tpu/core/logger.hpp"
+#include "raft_tpu/core/mdarray.hpp"
+#include "raft_tpu/core/resources.hpp"
+#include "raft_tpu/core/serialize.hpp"
+#include "raft_tpu/core/workspace.hpp"
+
+using namespace raft_tpu;
+
+namespace {
+thread_local std::string g_last_error;
+
+int fail(const std::exception& e) {
+  g_last_error = e.what();
+  return 1;
+}
+}  // namespace
+
+extern "C" {
+
+const char* rt_last_error() { return g_last_error.c_str(); }
+
+// ---------- resources ----------
+struct rt_resources_t;
+
+namespace {
+struct workspace_factory : resource_factory {
+  explicit workspace_factory(std::size_t limit) : limit_(limit) {}
+  resource_type type() const override { return resource_type::workspace; }
+  std::unique_ptr<resource> make() const override {
+    struct holder : resource {
+      explicit holder(std::size_t l) : arena(l) {}
+      void* get() override { return &arena; }
+      workspace_arena arena;
+    };
+    return std::make_unique<holder>(limit_);
+  }
+  std::size_t limit_;
+};
+}  // namespace
+
+void* rt_resources_create(size_t workspace_limit_bytes) {
+  try {
+    auto* r = new resources();
+    r->add_resource_factory(
+        std::make_shared<workspace_factory>(workspace_limit_bytes));
+    return r;
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void rt_resources_destroy(void* h) { delete static_cast<resources*>(h); }
+
+void* rt_resources_copy(void* h) {
+  // shallow copy sharing instantiated resources (reference semantics)
+  return new resources(*static_cast<resources*>(h));
+}
+
+// ---------- workspace ----------
+void* rt_workspace_alloc(void* res_h, size_t bytes) {
+  try {
+    auto* r = static_cast<resources*>(res_h);
+    auto* a = static_cast<workspace_arena*>(
+        r->get_resource(resource_type::workspace));
+    return a->allocate(bytes);
+  } catch (const std::exception& e) {
+    fail(e);
+    return nullptr;
+  }
+}
+
+int rt_workspace_free(void* res_h, void* p) {
+  try {
+    auto* r = static_cast<resources*>(res_h);
+    auto* a = static_cast<workspace_arena*>(
+        r->get_resource(resource_type::workspace));
+    a->deallocate(p);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e);
+  }
+}
+
+size_t rt_workspace_used(void* res_h) {
+  auto* r = static_cast<resources*>(res_h);
+  auto* a =
+      static_cast<workspace_arena*>(r->get_resource(resource_type::workspace));
+  return a->used();
+}
+
+size_t rt_workspace_high_water(void* res_h) {
+  auto* r = static_cast<resources*>(res_h);
+  auto* a =
+      static_cast<workspace_arena*>(r->get_resource(resource_type::workspace));
+  return a->high_water();
+}
+
+// ---------- logger ----------
+void rt_log_set_level(int level) {
+  logger::get().set_level(static_cast<log_level>(level));
+}
+int rt_log_get_level() { return static_cast<int>(logger::get().level()); }
+void rt_log_set_callback(logger::callback_t cb, void* user) {
+  logger::get().set_callback(cb, user);
+}
+void rt_log(int level, const char* msg) {
+  logger::get().log(static_cast<log_level>(level), "%s", msg);
+}
+
+// ---------- npy serialization ----------
+int rt_npy_write(const char* path, const void* data, const int64_t* shape,
+                 int rank, int dt) {
+  try {
+    std::vector<std::int64_t> sh(shape, shape + rank);
+    mdarray arr(sh, static_cast<dtype>(dt));
+    std::memcpy(arr.data(), data, arr.size_bytes());
+    std::ofstream os(path, std::ios::binary);
+    RAFT_TPU_EXPECTS(os.good(), std::string("cannot open ") + path);
+    serialize_mdarray(os, arr);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e);
+  }
+}
+
+// two-phase read: query geometry, then fill caller buffer
+int rt_npy_read_info(const char* path, int64_t* shape_out, int* rank_out,
+                     int* dtype_out, int max_rank) {
+  try {
+    std::ifstream is(path, std::ios::binary);
+    RAFT_TPU_EXPECTS(is.good(), std::string("cannot open ") + path);
+    mdarray arr = deserialize_mdarray(is);
+    RAFT_TPU_EXPECTS(arr.rank() <= max_rank, "rank exceeds caller buffer");
+    *rank_out = arr.rank();
+    *dtype_out = static_cast<int>(arr.type());
+    for (int i = 0; i < arr.rank(); ++i) shape_out[i] = arr.extent(i);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e);
+  }
+}
+
+int rt_npy_read(const char* path, void* data_out, size_t bytes) {
+  try {
+    std::ifstream is(path, std::ios::binary);
+    RAFT_TPU_EXPECTS(is.good(), std::string("cannot open ") + path);
+    mdarray arr = deserialize_mdarray(is);
+    RAFT_TPU_EXPECTS(arr.size_bytes() == bytes, "size mismatch");
+    std::memcpy(data_out, arr.data(), bytes);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e);
+  }
+}
+
+// ---------- interruptible ----------
+void* rt_interruptible_token() {
+  // shared_ptr kept alive by the registry; expose the raw pointer
+  return interruptible::get_token().get();
+}
+void rt_interruptible_cancel(void* tok) {
+  static_cast<interruptible*>(tok)->cancel();
+}
+int rt_interruptible_cancelled(void* tok) {
+  return static_cast<interruptible*>(tok)->cancelled() ? 1 : 0;
+}
+int rt_interruptible_check(void* tok) {
+  try {
+    static_cast<interruptible*>(tok)->check();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,128 @@
+// .npy writer/reader (see header for the format contract;
+// ref: core/detail/mdspan_numpy_serializer.hpp writes the same layout).
+#include "raft_tpu/core/serialize.hpp"
+
+#include <cstring>
+#include <istream>
+#include <ostream>
+#include <sstream>
+#include <string>
+
+namespace raft_tpu {
+
+namespace {
+
+const char* descr_of(dtype t) {
+  switch (t) {
+    case dtype::f32: return "<f4";
+    case dtype::f64: return "<f8";
+    case dtype::i8: return "|i1";
+    case dtype::u8: return "|u1";
+    case dtype::i32: return "<i4";
+    case dtype::i64: return "<i8";
+    case dtype::u32: return "<u4";
+    case dtype::f16: return "<f2";
+    case dtype::bf16: return "<V2";  // no npy bf16; raw 2-byte void
+    default: RAFT_TPU_FAIL("unknown dtype");
+  }
+}
+
+dtype dtype_of(const std::string& descr) {
+  if (descr == "<f4") return dtype::f32;
+  if (descr == "<f8") return dtype::f64;
+  if (descr == "|i1") return dtype::i8;
+  if (descr == "|u1") return dtype::u8;
+  if (descr == "<i4") return dtype::i32;
+  if (descr == "<i8") return dtype::i64;
+  if (descr == "<u4") return dtype::u32;
+  if (descr == "<f2") return dtype::f16;
+  if (descr == "<V2") return dtype::bf16;
+  RAFT_TPU_FAIL("unsupported npy descr: " + descr);
+}
+
+}  // namespace
+
+void serialize_mdarray(std::ostream& os, const mdarray& arr) {
+  std::ostringstream hdr;
+  hdr << "{'descr': '" << descr_of(arr.type())
+      << "', 'fortran_order': False, 'shape': (";
+  for (int i = 0; i < arr.rank(); ++i) {
+    hdr << arr.extent(i);
+    if (arr.rank() == 1 || i + 1 < arr.rank()) hdr << ",";
+    if (i + 1 < arr.rank()) hdr << " ";
+  }
+  hdr << "), }";
+  std::string h = hdr.str();
+  // pad so magic(6)+ver(2)+len(2)+header is a multiple of 64, ending in \n
+  std::size_t unpadded = 6 + 2 + 2 + h.size() + 1;
+  std::size_t padded = (unpadded + 63) & ~std::size_t{63};
+  h.append(padded - unpadded, ' ');
+  h.push_back('\n');
+
+  os.write("\x93NUMPY", 6);
+  os.put(1);
+  os.put(0);
+  std::uint16_t hlen = static_cast<std::uint16_t>(h.size());
+  os.write(reinterpret_cast<const char*>(&hlen), 2);
+  os.write(h.data(), static_cast<std::streamsize>(h.size()));
+  os.write(reinterpret_cast<const char*>(arr.data()),
+           static_cast<std::streamsize>(arr.size_bytes()));
+}
+
+mdarray deserialize_mdarray(std::istream& is) {
+  char magic[6];
+  is.read(magic, 6);
+  RAFT_TPU_EXPECTS(is.good() && std::memcmp(magic, "\x93NUMPY", 6) == 0,
+                   "not an npy stream");
+  char ver[2];
+  is.read(ver, 2);
+  std::uint16_t hlen = 0;
+  is.read(reinterpret_cast<char*>(&hlen), 2);
+  std::string h(hlen, '\0');
+  is.read(h.data(), hlen);
+
+  auto find_val = [&](const std::string& key) -> std::string {
+    auto p = h.find("'" + key + "'");
+    RAFT_TPU_EXPECTS(p != std::string::npos, "npy header missing " + key);
+    p = h.find(':', p);
+    return h.substr(p + 1);
+  };
+
+  std::string d = find_val("descr");
+  auto q0 = d.find('\'');
+  auto q1 = d.find('\'', q0 + 1);
+  dtype dt = dtype_of(d.substr(q0 + 1, q1 - q0 - 1));
+
+  RAFT_TPU_EXPECTS(find_val("fortran_order").find("False") != std::string::npos,
+                   "fortran order unsupported");
+
+  std::string s = find_val("shape");
+  auto l = s.find('(');
+  auto r = s.find(')', l);
+  std::vector<std::int64_t> shape;
+  std::stringstream ss(s.substr(l + 1, r - l - 1));
+  std::string tok;
+  while (std::getline(ss, tok, ',')) {
+    // skip blank trailing token from "(n,)" style tuples
+    bool has_digit = tok.find_first_of("0123456789") != std::string::npos;
+    if (has_digit) shape.push_back(std::stoll(tok));
+  }
+
+  mdarray out(shape, dt);
+  is.read(reinterpret_cast<char*>(out.data()),
+          static_cast<std::streamsize>(out.size_bytes()));
+  RAFT_TPU_EXPECTS(is.good() || is.eof(), "truncated npy payload");
+  return out;
+}
+
+void serialize_scalar_i64(std::ostream& os, std::int64_t v) {
+  os.write(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+
+std::int64_t deserialize_scalar_i64(std::istream& is) {
+  std::int64_t v = 0;
+  is.read(reinterpret_cast<char*>(&v), sizeof(v));
+  return v;
+}
+
+}  // namespace raft_tpu

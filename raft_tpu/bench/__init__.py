@@ -1,0 +1,10 @@
+"""ANN benchmark harness (ref: python/raft-ann-bench/ + cpp/bench/ann/).
+
+Components mirror the reference suite (SURVEY §2.14/§2.15):
+datasets (get_dataset/generate_groundtruth), run (JSON-config orchestrator
+computing QPS/latency/recall), data_export (CSV), plot (recall/QPS pareto
+frontier)."""
+
+from raft_tpu.bench import datasets, export, plot, runner
+
+__all__ = ["datasets", "export", "plot", "runner"]

@@ -1,0 +1,88 @@
+"""CLI: ``python -m raft_tpu.bench --dataset sift-128-euclidean --scale 0.01``
+(ref: ``python -m raft_ann_bench.run`` orchestrator CLI,
+run/__main__.py:115-190)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# platform override must land before any backend is initialized (this image
+# pre-imports jax with the TPU platform forced; jax.config still wins if no
+# backend has been touched yet)
+if os.environ.get("RAFT_TPU_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])
+
+from raft_tpu.bench import datasets, export, plot, runner
+
+DEFAULT_CONFIG = {
+    "algos": [
+        {"name": "raft_tpu_brute_force", "build_param": {}, "search_params": [{}]},
+        {
+            "name": "raft_tpu_ivf_flat",
+            "build_param": {"n_lists": 256},
+            "search_params": [{"n_probes": p} for p in (8, 16, 32, 64)],
+        },
+        {
+            "name": "raft_tpu_ivf_pq",
+            "build_param": {"n_lists": 256, "pq_bits": 8},
+            "search_params": [
+                {"n_probes": p, "refine_ratio": r}
+                for p in (8, 32) for r in (1, 2)
+            ],
+        },
+        {
+            "name": "raft_tpu_cagra",
+            "build_param": {"graph_degree": 32, "intermediate_graph_degree": 64},
+            "search_params": [{"itopk_size": t} for t in (32, 64, 128)],
+        },
+    ]
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("raft_tpu.bench")
+    ap.add_argument("--dataset", default="sift-128-euclidean")
+    ap.add_argument("--scale", type=float, default=0.01,
+                    help="fraction of the standard dataset size to generate")
+    ap.add_argument("--config", default="", help="JSON config path")
+    ap.add_argument("-k", type=int, default=10)
+    ap.add_argument("--out", default="bench_results")
+    ap.add_argument("--algorithms", default="",
+                    help="comma-separated filter over config algos")
+    args = ap.parse_args(argv)
+
+    config = (
+        json.load(open(args.config)) if args.config else DEFAULT_CONFIG
+    )
+    if args.algorithms:
+        keep = set(args.algorithms.split(","))
+        config = {"algos": [a for a in config["algos"] if a["name"] in keep]}
+
+    ds = datasets.synthetic(args.dataset, scale=args.scale)
+    datasets.generate_groundtruth(ds, k=max(args.k, 100))
+    results = runner.run_config(ds, config, k=args.k)
+
+    os.makedirs(args.out, exist_ok=True)
+    base = os.path.join(args.out, f"{args.dataset}")
+    runner.save_results(results, base + ".json")
+    export.to_csv(results, base + ".csv")
+    try:
+        plot.plot_results(results, base + ".png")
+    except Exception as e:  # plotting is best-effort (headless variations)
+        print(f"plot skipped: {e}", file=sys.stderr)
+    for r in results:
+        print(
+            f"{r.algo:24s} recall={r.recall:.4f} qps={r.qps:10.1f} "
+            f"latency={r.latency_ms:.3f}ms build={r.build_time_s:.1f}s "
+            f"{r.search_param}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

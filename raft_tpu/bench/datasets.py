@@ -1,0 +1,156 @@
+"""Benchmark datasets: binary readers/writers, synthetic generators,
+groundtruth computation.
+
+Reference: raft-ann-bench ``get_dataset`` / ``generate_groundtruth``
+(python/raft-ann-bench/src/raft_ann_bench/{get_dataset,generate_groundtruth})
+and the big-ann binary formats it consumes (.fbin/.u8bin/.ibin: int32 count,
+int32 dim, then row-major payload; hdf5 ann-benchmarks files with
+train/test/neighbors/distances groups).
+
+This environment has no network egress, so ``get_dataset``'s download step
+is replaced by deterministic synthetic generators with the standard
+million-scale shapes (sift-128, glove-100, …); files round-trip through the
+same binary formats so externally fetched datasets drop in unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.neighbors import brute_force
+
+_DTYPES = {"fbin": np.float32, "u8bin": np.uint8, "i8bin": np.int8, "ibin": np.int32}
+
+
+def write_bin(path: str, arr: np.ndarray) -> None:
+    """big-ann binary writer: [n:int32][dim:int32][payload row-major]."""
+    arr = np.ascontiguousarray(arr)
+    with open(path, "wb") as fh:
+        fh.write(np.asarray(arr.shape, np.int32).tobytes())
+        fh.write(arr.tobytes())
+
+
+def read_bin(path: str, dtype=None) -> np.ndarray:
+    if dtype is None:
+        ext = path.rsplit(".", 1)[-1]
+        dtype = _DTYPES.get(ext, np.float32)
+    with open(path, "rb") as fh:
+        n, dim = np.frombuffer(fh.read(8), np.int32)
+        data = np.frombuffer(fh.read(), dtype)
+    return data.reshape(int(n), int(dim))
+
+
+@dataclass
+class Dataset:
+    name: str
+    base: np.ndarray        # [n, d]
+    queries: np.ndarray     # [q, d]
+    gt_neighbors: Optional[np.ndarray] = None   # [q, k]
+    gt_distances: Optional[np.ndarray] = None
+    metric: str = "sqeuclidean"
+
+
+# standard dataset geometries (ref: docs/source/raft_ann_benchmarks.md:289-294
+# million-scale suite + run/conf/*.json dataset blocks)
+_SYNTH_SHAPES = {
+    "sift-128-euclidean": (1_000_000, 128, 10_000, "sqeuclidean"),
+    "glove-100-inner": (1_183_514, 100, 10_000, "inner_product"),
+    "fashion-mnist-784-euclidean": (60_000, 784, 10_000, "sqeuclidean"),
+    "nytimes-256-angular": (290_000, 256, 10_000, "cosine"),
+    "mnist-784-euclidean": (60_000, 784, 10_000, "sqeuclidean"),
+    "deep-image-96-inner": (9_990_000, 96, 10_000, "inner_product"),
+}
+
+
+def synthetic(
+    name: str = "sift-128-euclidean",
+    *,
+    scale: float = 1.0,
+    n_queries: int = 0,
+    seed: int = 0,
+    clustered: bool = True,
+) -> Dataset:
+    """Deterministic synthetic stand-in with a standard dataset's geometry.
+    ``scale`` shrinks n for quick runs (scale=0.01 → 1% of the rows)."""
+    if name not in _SYNTH_SHAPES:
+        raise ValueError(f"unknown dataset {name}; have {sorted(_SYNTH_SHAPES)}")
+    n, d, q, metric = _SYNTH_SHAPES[name]
+    n = max(1000, int(n * scale))
+    q = n_queries or min(q, max(100, n // 100))
+    rng = np.random.default_rng(seed)
+    if clustered:
+        # mixture of gaussians — ANN-relevant structure (pure uniform data
+        # has no cluster structure for IVF/graph indexes to exploit)
+        n_clusters = max(16, int(np.sqrt(n) / 4))
+        centers = rng.random((n_clusters, d), dtype=np.float32) * 10
+        lab = rng.integers(0, n_clusters, n)
+        base = centers[lab] + rng.normal(0, 1.0, (n, d)).astype(np.float32)
+        qlab = rng.integers(0, n_clusters, q)
+        queries = centers[qlab] + rng.normal(0, 1.0, (q, d)).astype(np.float32)
+    else:
+        base = rng.random((n, d), dtype=np.float32)
+        queries = rng.random((q, d), dtype=np.float32)
+    return Dataset(name=name, base=base, queries=queries, metric=metric)
+
+
+def generate_groundtruth(
+    ds: Dataset, k: int = 100, *, batch: int = 2048,
+    res: Optional[Resources] = None,
+) -> Dataset:
+    """Exact groundtruth via device brute force (ref: raft-ann-bench
+    generate_groundtruth — it likewise runs pylibraft brute_force on GPU)."""
+    res = ensure(res)
+    import jax.numpy as jnp
+
+    base = jnp.asarray(ds.base)
+    dists, ids = [], []
+    for s in range(0, ds.queries.shape[0], batch):
+        v, i = brute_force.knn(
+            base, jnp.asarray(ds.queries[s : s + batch]), k,
+            metric=ds.metric, res=res,
+        )
+        dists.append(np.asarray(v))
+        ids.append(np.asarray(i))
+    ds.gt_distances = np.concatenate(dists)
+    ds.gt_neighbors = np.concatenate(ids)
+    return ds
+
+
+def save(ds: Dataset, directory: str) -> None:
+    """Persist in the big-ann layout raft-ann-bench uses
+    (base.fbin / query.fbin / groundtruth.neighbors.ibin / ...distances.fbin)."""
+    os.makedirs(directory, exist_ok=True)
+    write_bin(os.path.join(directory, "base.fbin"), ds.base)
+    write_bin(os.path.join(directory, "query.fbin"), ds.queries)
+    if ds.gt_neighbors is not None:
+        write_bin(
+            os.path.join(directory, "groundtruth.neighbors.ibin"),
+            ds.gt_neighbors.astype(np.int32),
+        )
+        write_bin(
+            os.path.join(directory, "groundtruth.distances.fbin"),
+            ds.gt_distances.astype(np.float32),
+        )
+
+
+def load(directory: str, name: str = "", metric: str = "sqeuclidean") -> Dataset:
+    ds = Dataset(
+        name=name or os.path.basename(directory.rstrip("/")),
+        base=read_bin(os.path.join(directory, "base.fbin")),
+        queries=read_bin(os.path.join(directory, "query.fbin")),
+        metric=metric,
+    )
+    gtn = os.path.join(directory, "groundtruth.neighbors.ibin")
+    if os.path.exists(gtn):
+        ds.gt_neighbors = read_bin(gtn, np.int32)
+        ds.gt_distances = read_bin(
+            os.path.join(directory, "groundtruth.distances.fbin"), np.float32
+        )
+    return ds

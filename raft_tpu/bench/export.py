@@ -1,0 +1,33 @@
+"""Result export to CSV (ref: raft-ann-bench data_export — flattens the
+per-run JSON into build/search CSV tables for plotting)."""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import List
+
+from raft_tpu.bench.runner import RunResult
+
+_FIELDS = [
+    "algo", "dataset", "k", "build_param", "search_param",
+    "build_time_s", "qps", "latency_ms", "recall", "end_to_end_s",
+]
+
+
+def to_csv(results: List[RunResult], path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=_FIELDS)
+        w.writeheader()
+        for r in results:
+            d = r.to_dict()
+            d["build_param"] = json.dumps(d["build_param"])
+            d["search_param"] = json.dumps(d["search_param"])
+            w.writerow(d)
+
+
+def from_json(path: str) -> List[RunResult]:
+    with open(path) as fh:
+        return [RunResult(**d) for d in json.load(fh)]

@@ -1,0 +1,277 @@
+"""Benchmark orchestrator: algorithm wrappers + QPS/latency/recall runner.
+
+Reference: the abstract ANN interface ``cpp/bench/ann/src/common/
+ann_types.hpp:79-157`` (build / set_search_param / search / save / load),
+the gbench driver computing QPS, latency, GPU-time and Recall counters
+(``cpp/bench/ann/src/common/benchmark.hpp:120-379``), and the Python
+orchestrator that launches runs from JSON configs
+(python/raft-ann-bench/src/raft_ann_bench/run/__main__.py:115-190).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.bench.datasets import Dataset
+from raft_tpu.stats import neighborhood_recall
+
+
+class ANN:
+    """Algorithm wrapper interface (ref: ann_types.hpp ANN<T>)."""
+
+    name = "base"
+
+    def __init__(self, metric: str, build_param: Dict[str, Any]):
+        self.metric = metric
+        self.build_param = build_param
+
+    def build(self, dataset: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def set_search_param(self, param: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def search(self, queries: jnp.ndarray, k: int):
+        raise NotImplementedError
+
+    def save(self, path: str) -> None:
+        pass
+
+    def load(self, path: str) -> None:
+        pass
+
+
+class BruteForceANN(ANN):
+    name = "raft_tpu_brute_force"
+
+    def build(self, dataset):
+        from raft_tpu.neighbors import brute_force
+
+        self._mod = brute_force
+        self._index = brute_force.build(jnp.asarray(dataset), metric=self.metric)
+
+    def set_search_param(self, param):
+        pass
+
+    def search(self, queries, k):
+        return self._mod.search(self._index, queries, k)
+
+    def save(self, path):
+        self._mod.save(path, self._index)
+
+
+class IvfFlatANN(ANN):
+    name = "raft_tpu_ivf_flat"
+
+    def build(self, dataset):
+        from raft_tpu.neighbors import ivf_flat
+
+        self._mod = ivf_flat
+        params = ivf_flat.IndexParams(metric=self.metric, **self.build_param)
+        self._index = ivf_flat.build(params, jnp.asarray(dataset))
+        self._sp = ivf_flat.SearchParams()
+
+    def set_search_param(self, param):
+        from raft_tpu.neighbors import ivf_flat
+
+        self._sp = ivf_flat.SearchParams(**param)
+
+    def search(self, queries, k):
+        return self._mod.search(self._sp, self._index, queries, k)
+
+    def save(self, path):
+        self._mod.save(path, self._index)
+
+
+class IvfPqANN(ANN):
+    name = "raft_tpu_ivf_pq"
+
+    def build(self, dataset):
+        from raft_tpu.neighbors import ivf_pq
+
+        self._mod = ivf_pq
+        self._refine_ratio = 1
+        params = ivf_pq.IndexParams(metric=self.metric, **self.build_param)
+        self._dataset = jnp.asarray(dataset)
+        self._index = ivf_pq.build(params, self._dataset)
+        self._sp = ivf_pq.SearchParams()
+
+    def set_search_param(self, param):
+        from raft_tpu.neighbors import ivf_pq
+
+        param = dict(param)
+        self._refine_ratio = int(param.pop("refine_ratio", 1))
+        self._sp = ivf_pq.SearchParams(**param)
+
+    def search(self, queries, k):
+        from raft_tpu.neighbors import refine
+
+        if self._refine_ratio > 1:
+            _, cand = self._mod.search(
+                self._sp, self._index, queries, k * self._refine_ratio
+            )
+            return refine(self._dataset, queries, cand, k, metric=self.metric)
+        return self._mod.search(self._sp, self._index, queries, k)
+
+    def save(self, path):
+        self._mod.save(path, self._index)
+
+
+class CagraANN(ANN):
+    name = "raft_tpu_cagra"
+
+    def build(self, dataset):
+        from raft_tpu.neighbors import cagra
+
+        self._mod = cagra
+        params = cagra.IndexParams(metric=self.metric, **self.build_param)
+        self._index = cagra.build(params, jnp.asarray(dataset))
+        self._sp = cagra.SearchParams()
+
+    def set_search_param(self, param):
+        from raft_tpu.neighbors import cagra
+
+        self._sp = cagra.SearchParams(**param)
+
+    def search(self, queries, k):
+        return self._mod.search(self._sp, self._index, queries, k)
+
+    def save(self, path):
+        self._mod.save(path, self._index)
+
+
+class BallCoverANN(ANN):
+    name = "raft_tpu_ball_cover"
+
+    def build(self, dataset):
+        from raft_tpu.neighbors import ball_cover
+
+        self._mod = ball_cover
+        self._index = ball_cover.build(
+            jnp.asarray(dataset), metric=self.metric, **self.build_param
+        )
+        self._n_probes = 0
+
+    def set_search_param(self, param):
+        self._n_probes = int(param.get("n_probes", 0))
+
+    def search(self, queries, k):
+        return self._mod.knn_query(self._index, queries, k, n_probes=self._n_probes)
+
+
+ALGORITHMS = {
+    a.name: a
+    for a in (BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, BallCoverANN)
+}
+
+
+@dataclass
+class RunResult:
+    """One (algo, build_param, search_param) measurement — the counters the
+    reference's gbench driver reports (benchmark.hpp:330-379)."""
+
+    algo: str
+    dataset: str
+    k: int
+    build_param: Dict[str, Any]
+    search_param: Dict[str, Any]
+    build_time_s: float
+    qps: float
+    latency_ms: float
+    recall: float
+    end_to_end_s: float
+
+    def to_dict(self):
+        return {
+            "algo": self.algo, "dataset": self.dataset, "k": self.k,
+            "build_param": self.build_param, "search_param": self.search_param,
+            "build_time_s": self.build_time_s, "qps": self.qps,
+            "latency_ms": self.latency_ms, "recall": self.recall,
+            "end_to_end_s": self.end_to_end_s,
+        }
+
+
+def run_case(
+    ds: Dataset,
+    algo_name: str,
+    build_param: Dict[str, Any],
+    search_params: List[Dict[str, Any]],
+    *,
+    k: int = 10,
+    warmup: int = 1,
+    iters: int = 3,
+    res: Optional[Resources] = None,
+) -> List[RunResult]:
+    """Build once, sweep search params (ref: run/__main__.py one executable
+    invocation per build config with a search-param grid)."""
+    if ds.gt_neighbors is None:
+        raise ValueError("dataset has no groundtruth; run generate_groundtruth")
+    res = ensure(res)
+    cls = ALGORITHMS[algo_name]
+    algo = cls(ds.metric, build_param)
+    t0 = time.perf_counter()
+    algo.build(ds.base)
+    jax.block_until_ready(getattr(algo, "_index", jnp.zeros(())))
+    build_time = time.perf_counter() - t0
+
+    queries = jnp.asarray(ds.queries)
+    nq = ds.queries.shape[0]
+    out = []
+    for sp in search_params:
+        algo.set_search_param(sp)
+        for _ in range(warmup):
+            jax.block_until_ready(algo.search(queries, k))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            v, i = algo.search(queries, k)
+        jax.block_until_ready((v, i))
+        dt = (time.perf_counter() - t0) / iters
+        rec = float(
+            neighborhood_recall(np.asarray(i), ds.gt_neighbors[:, :k])
+        )
+        out.append(
+            RunResult(
+                algo=algo_name, dataset=ds.name, k=k,
+                build_param=build_param, search_param=sp,
+                build_time_s=build_time,
+                qps=nq / dt,
+                latency_ms=dt / nq * 1e3,
+                recall=rec,
+                end_to_end_s=dt,
+            )
+        )
+    return out
+
+
+def run_config(
+    ds: Dataset, config: Dict[str, Any], *, k: int = 10,
+    res: Optional[Resources] = None,
+) -> List[RunResult]:
+    """Execute a JSON config shaped like the reference's run/conf files:
+    {"algos": [{"name": ..., "build_param": {...},
+                "search_params": [{...}, ...]}, ...]}."""
+    results = []
+    for spec in config["algos"]:
+        results.extend(
+            run_case(
+                ds, spec["name"], spec.get("build_param", {}),
+                spec.get("search_params", [{}]), k=k, res=res,
+            )
+        )
+    return results
+
+
+def save_results(results: List[RunResult], path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump([r.to_dict() for r in results], fh, indent=2)

@@ -1,0 +1,12 @@
+"""pylibraft-compatible API surface (ref: python/pylibraft/ — SURVEY §2.14).
+
+``raft_tpu.compat.pylibraft`` mirrors the reference's Python package layout
+(common/distance/matrix/cluster/neighbors/random) so code written against
+pylibraft ports by switching the import root. Arrays in are anything
+array-like; outputs follow ``config.set_output_as`` (default: device arrays,
+like pylibraft's device_ndarray default).
+"""
+
+from raft_tpu.compat import pylibraft
+
+__all__ = ["pylibraft"]

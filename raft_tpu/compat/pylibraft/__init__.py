@@ -1,0 +1,15 @@
+"""Drop-in pylibraft namespace (ref: python/pylibraft/pylibraft/)."""
+
+from raft_tpu.compat.pylibraft import (
+    cluster,
+    common,
+    config,
+    distance,
+    matrix,
+    neighbors,
+    random,
+)
+
+__all__ = [
+    "cluster", "common", "config", "distance", "matrix", "neighbors", "random",
+]

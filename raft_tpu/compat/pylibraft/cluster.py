@@ -1,0 +1,51 @@
+"""(ref: pylibraft.cluster — kmeans.pyx: KMeansParams, fit, cluster_cost,
+compute_new_centroids)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raft_tpu.cluster import kmeans as _kmeans
+from raft_tpu.compat.pylibraft.common import DeviceResources, to_device_array
+from raft_tpu.compat.pylibraft.config import convert_output
+
+KMeansParams = _kmeans.KMeansParams
+
+
+class kmeans:
+    """Namespace parity with pylibraft.cluster.kmeans."""
+
+    KMeansParams = _kmeans.KMeansParams
+
+    @staticmethod
+    def fit(params, X, sample_weights=None, handle: Optional[DeviceResources] = None):
+        res = handle.res if handle else None
+        centroids, inertia, n_iter = _kmeans.fit(
+            params, to_device_array(X),
+            None if sample_weights is None else to_device_array(sample_weights),
+            res=res,
+        )
+        return convert_output(centroids), float(inertia), int(n_iter)
+
+    @staticmethod
+    def cluster_cost(X, centroids, handle: Optional[DeviceResources] = None):
+        return float(
+            _kmeans.cluster_cost(to_device_array(X), to_device_array(centroids))
+        )
+
+    @staticmethod
+    def compute_new_centroids(
+        X, centroids, labels=None, sample_weights=None,
+        handle: Optional[DeviceResources] = None,
+    ):
+        out = _kmeans.compute_new_centroids(
+            to_device_array(X), to_device_array(centroids),
+            None if labels is None else to_device_array(labels),
+            None if sample_weights is None else to_device_array(sample_weights),
+        )
+        return convert_output(out)
+
+
+fit = kmeans.fit
+cluster_cost = kmeans.cluster_cost
+compute_new_centroids = kmeans.compute_new_centroids

@@ -1,0 +1,73 @@
+"""Handle + device array shims (ref: pylibraft/common/ — handle.pyx
+DeviceResources, device_ndarray.py, cai_wrapper.py, auto_sync_handle).
+
+On TPU the "handle" wraps raft_tpu.core.Resources (workspace limits, PRNG
+root) and ``sync()`` maps to block_until_ready of outstanding work — the
+async-dispatch analog of the reference's stream sync."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources
+
+
+class DeviceResources:
+    """(ref: pylibraft.common.DeviceResources / device_resources handle)"""
+
+    def __init__(self, workspace_limit_bytes: int = 256 * 1024 * 1024):
+        self.res = Resources(workspace_limit_bytes=workspace_limit_bytes)
+
+    def sync(self) -> None:
+        # XLA dispatch is async like CUDA streams; a barrier on a trivial
+        # computation flushes the queue (ref handle.sync semantics)
+        jax.block_until_ready(jnp.zeros(()))
+
+
+# legacy alias (ref: pylibraft Handle = DeviceResources)
+Handle = DeviceResources
+
+
+class device_ndarray:
+    """Minimal device array owner (ref: pylibraft/common/device_ndarray.py —
+    there backed by rmm DeviceBuffer + __cuda_array_interface__; here a jax
+    Array with numpy bridging)."""
+
+    def __init__(self, np_arr):
+        self._array = jnp.asarray(np_arr)
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C"):
+        if order != "C":
+            raise ValueError("row-major only on TPU")
+        return cls(np.empty(shape, dtype))
+
+    @property
+    def shape(self):
+        return self._array.shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype.name)
+
+    def copy_to_host(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def __array__(self):
+        return np.asarray(self._array)
+
+    @property
+    def array(self) -> jax.Array:
+        return self._array
+
+
+def to_device_array(x) -> jax.Array:
+    """Accept numpy / jax / device_ndarray / anything __array__-able
+    (ref: cai_wrapper's __cuda_array_interface__ bridging)."""
+    if isinstance(x, device_ndarray):
+        return x.array
+    return jnp.asarray(x)

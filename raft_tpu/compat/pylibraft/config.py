@@ -1,0 +1,30 @@
+"""Global output-conversion hook (ref: pylibraft config.set_output_as,
+docs/source/quick_start.md:156-166 — "numpy" | "cupy" | callable; here
+"numpy" | "jax" | callable)."""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import numpy as np
+
+_output_as = "jax"
+
+
+def set_output_as(kind: Union[str, Callable]) -> None:
+    global _output_as
+    if not (kind in ("jax", "numpy", "device") or callable(kind)):
+        raise ValueError("set_output_as expects 'jax', 'numpy', or a callable")
+    _output_as = kind
+
+
+def get_output_as():
+    return _output_as
+
+
+def convert_output(x):
+    if _output_as in ("jax", "device"):
+        return x
+    if _output_as == "numpy":
+        return np.asarray(x)
+    return _output_as(x)

@@ -1,0 +1,34 @@
+"""(ref: pylibraft.distance — pairwise_distance.pyx, fused_l2_nn.pyx)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raft_tpu.compat.pylibraft.common import DeviceResources, to_device_array
+from raft_tpu.compat.pylibraft.config import convert_output
+from raft_tpu.distance import fused_nn as _fused
+from raft_tpu.distance import pairwise as _pairwise
+
+DISTANCE_TYPES = sorted(_pairwise.DISTANCE_TYPES)
+
+
+def pairwise_distance(X, Y, metric="euclidean", p=2.0, handle: Optional[DeviceResources] = None):
+    res = handle.res if handle else None
+    out = _pairwise.pairwise_distance(
+        to_device_array(X), to_device_array(Y), metric=metric, p=p, res=res
+    )
+    return convert_output(out)
+
+
+def fused_l2_nn_argmin(X, Y, handle: Optional[DeviceResources] = None):
+    res = handle.res if handle else None
+    out = _fused.fused_l2_nn_argmin(to_device_array(X), to_device_array(Y), res=res)
+    return convert_output(out)
+
+
+def fused_distance_nn_argmin(X, Y, metric="euclidean", handle: Optional[DeviceResources] = None):
+    res = handle.res if handle else None
+    out = _fused.fused_distance_nn_argmin(
+        to_device_array(X), to_device_array(Y), metric=metric, res=res
+    )
+    return convert_output(out)

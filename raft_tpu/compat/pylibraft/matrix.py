@@ -1,0 +1,16 @@
+"""(ref: pylibraft.matrix — select_k.pyx)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raft_tpu.compat.pylibraft.common import DeviceResources, to_device_array
+from raft_tpu.compat.pylibraft.config import convert_output
+from raft_tpu.ops import matrix as _matrix
+
+
+def select_k(dataset, k, select_min=True, handle: Optional[DeviceResources] = None):
+    vals, idx = _matrix.select_k(
+        to_device_array(dataset), int(k), select_min=select_min
+    )
+    return convert_output(vals), convert_output(idx)

@@ -1,0 +1,159 @@
+"""(ref: pylibraft.neighbors — brute_force.pyx, ivf_flat/, ivf_pq/,
+cagra/, hnsw.pyx, refine.pyx, rbc.pyx, eps_neighborhood.pyx)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu.compat.pylibraft.common import DeviceResources, to_device_array
+from raft_tpu.compat.pylibraft.config import convert_output
+from raft_tpu.neighbors import ball_cover as _ball_cover
+from raft_tpu.neighbors import brute_force as _bf
+from raft_tpu.neighbors import cagra as _cagra
+from raft_tpu.neighbors import extras as _extras
+from raft_tpu.neighbors import hnsw as _hnsw
+from raft_tpu.neighbors import ivf_flat as _ivf_flat
+from raft_tpu.neighbors import ivf_pq as _ivf_pq
+from raft_tpu.neighbors.refine import refine as _refine
+
+
+def _res(handle):
+    return handle.res if handle else None
+
+
+class brute_force:
+    @staticmethod
+    def knn(dataset, queries, k, metric="sqeuclidean",
+            handle: Optional[DeviceResources] = None):
+        d, i = _bf.knn(
+            to_device_array(dataset), to_device_array(queries), int(k),
+            metric=metric, res=_res(handle),
+        )
+        return convert_output(d), convert_output(i)
+
+
+class _IndexModule:
+    """Shared shape of the ivf_flat / ivf_pq / cagra compat namespaces:
+    IndexParams/SearchParams/build/search/extend/save/load passthroughs
+    (ref: each pylibraft sub-package exposes exactly this surface)."""
+
+    _mod = None
+
+    @classmethod
+    def build(cls, params, dataset, handle: Optional[DeviceResources] = None):
+        return cls._mod.build(params, to_device_array(dataset), res=_res(handle))
+
+    @classmethod
+    def search(cls, params, index, queries, k,
+               handle: Optional[DeviceResources] = None):
+        d, i = cls._mod.search(
+            params, index, to_device_array(queries), int(k), res=_res(handle)
+        )
+        return convert_output(d), convert_output(i)
+
+    @classmethod
+    def save(cls, filename, index):
+        cls._mod.save(filename, index)
+
+    @classmethod
+    def load(cls, filename):
+        return cls._mod.load(filename)
+
+
+class ivf_flat(_IndexModule):
+    _mod = _ivf_flat
+    IndexParams = _ivf_flat.IndexParams
+    SearchParams = _ivf_flat.SearchParams
+
+    @classmethod
+    def extend(cls, index, new_vectors, new_indices=None,
+               handle: Optional[DeviceResources] = None):
+        return _ivf_flat.extend(
+            index, to_device_array(new_vectors),
+            None if new_indices is None else to_device_array(new_indices),
+            res=_res(handle),
+        )
+
+
+class ivf_pq(_IndexModule):
+    _mod = _ivf_pq
+    IndexParams = _ivf_pq.IndexParams
+    SearchParams = _ivf_pq.SearchParams
+
+    @classmethod
+    def extend(cls, index, new_vectors, new_indices=None,
+               handle: Optional[DeviceResources] = None):
+        return _ivf_pq.extend(
+            index, to_device_array(new_vectors),
+            None if new_indices is None else to_device_array(new_indices),
+            res=_res(handle),
+        )
+
+
+class cagra(_IndexModule):
+    _mod = _cagra
+    IndexParams = _cagra.IndexParams
+    SearchParams = _cagra.SearchParams
+
+
+class hnsw:
+    """(ref: pylibraft.neighbors.hnsw + cagra hnswlib export)"""
+
+    @staticmethod
+    def from_cagra(index, filename):
+        _hnsw.serialize_to_hnswlib(filename, index)
+        return _hnsw.load(filename, dim=index.dim, metric=index.metric)
+
+    @staticmethod
+    def load(filename, dim, metric="sqeuclidean"):
+        return _hnsw.load(filename, dim=dim, metric=metric)
+
+    @staticmethod
+    def search(index, queries, k, ef=64, handle: Optional[DeviceResources] = None):
+        d, i = _hnsw.search(index, to_device_array(queries), int(k), ef=ef,
+                            res=_res(handle))
+        return convert_output(d), convert_output(i)
+
+
+def refine(dataset, queries, candidates, k, metric="sqeuclidean",
+           handle: Optional[DeviceResources] = None):
+    d, i = _refine(
+        to_device_array(dataset), to_device_array(queries),
+        to_device_array(candidates), int(k), metric=metric, res=_res(handle),
+    )
+    return convert_output(d), convert_output(i)
+
+
+class rbc:
+    """(ref: pylibraft.neighbors.rbc — random ball cover)"""
+
+    @staticmethod
+    def build(dataset, metric="sqeuclidean", n_landmarks=0,
+              handle: Optional[DeviceResources] = None):
+        return _ball_cover.build(
+            to_device_array(dataset), metric=metric, n_landmarks=n_landmarks,
+            res=_res(handle),
+        )
+
+    @staticmethod
+    def query(index, queries, k, handle: Optional[DeviceResources] = None):
+        d, i = _ball_cover.knn_query(
+            index, to_device_array(queries), int(k), res=_res(handle)
+        )
+        return convert_output(d), convert_output(i)
+
+    @staticmethod
+    def eps_query(index, queries, eps, handle: Optional[DeviceResources] = None):
+        adj, deg = _ball_cover.eps_nn(
+            index, to_device_array(queries), eps, res=_res(handle)
+        )
+        return convert_output(adj), convert_output(deg)
+
+
+def eps_neighborhood(x, y, eps_sq, handle: Optional[DeviceResources] = None):
+    adj, deg = _extras.epsilon_neighborhood(
+        to_device_array(x), to_device_array(y), eps_sq, res=_res(handle)
+    )
+    return convert_output(adj), convert_output(deg)

@@ -1,0 +1,22 @@
+"""(ref: pylibraft.random — rmat_rectangular_generator.pyx)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from raft_tpu.compat.pylibraft.common import DeviceResources
+from raft_tpu.compat.pylibraft.config import convert_output
+from raft_tpu.random import datagen as _datagen
+
+
+def rmat(r_scale, c_scale, n_edges, theta=None, seed=12345,
+         handle: Optional[DeviceResources] = None):
+    key = jax.random.PRNGKey(seed)
+    out = _datagen.rmat(
+        key, int(r_scale), int(c_scale), int(n_edges),
+        theta=None if theta is None else np.asarray(theta),
+    )
+    return convert_output(out)

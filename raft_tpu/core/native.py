@@ -1,0 +1,220 @@
+"""ctypes binding to the native core (libraft_tpu_core.so).
+
+The C ABI plays the reference's ``raft_runtime`` role (SURVEY §2.15): a
+stable non-templated boundary between the native runtime (resources,
+workspace arena, logger, npy serializer, interruptible — cpp/include/
+raft_tpu/core/) and Python. The library auto-builds from cpp/ on first use
+(make, ~1s, no dependencies); everything degrades gracefully when no
+toolchain is present (``available()`` → False).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LIB = None
+_LOCK = threading.Lock()
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "cpp")
+
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int8): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int64): 5,
+    np.dtype(np.uint32): 6,
+    np.dtype(np.float16): 7,
+}
+_DTYPES_INV = {v: k for k, v in _DTYPES.items()}
+
+LOG_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p)
+
+
+def _build() -> Optional[str]:
+    cpp = os.path.abspath(_CPP_DIR)
+    so = os.path.join(cpp, "libraft_tpu_core.so")
+    srcs = [os.path.join(cpp, "src", s) for s in ("serialize.cc", "c_api.cc")]
+    if os.path.exists(so) and all(
+        os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs
+    ):
+        return so
+    try:
+        subprocess.run(
+            ["make", "-C", cpp, "-j4"], check=True,
+            capture_output=True, timeout=120,
+        )
+        return so if os.path.exists(so) else None
+    except Exception:
+        return None
+
+
+def _load():
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        so = _build()
+        if so is None:
+            _LIB = False
+            return _LIB
+        lib = ctypes.CDLL(so)
+        lib.rt_last_error.restype = ctypes.c_char_p
+        lib.rt_resources_create.restype = ctypes.c_void_p
+        lib.rt_resources_create.argtypes = [ctypes.c_size_t]
+        lib.rt_resources_destroy.argtypes = [ctypes.c_void_p]
+        lib.rt_resources_copy.restype = ctypes.c_void_p
+        lib.rt_resources_copy.argtypes = [ctypes.c_void_p]
+        lib.rt_workspace_alloc.restype = ctypes.c_void_p
+        lib.rt_workspace_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.rt_workspace_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.rt_workspace_used.restype = ctypes.c_size_t
+        lib.rt_workspace_used.argtypes = [ctypes.c_void_p]
+        lib.rt_workspace_high_water.restype = ctypes.c_size_t
+        lib.rt_workspace_high_water.argtypes = [ctypes.c_void_p]
+        lib.rt_log_set_level.argtypes = [ctypes.c_int]
+        lib.rt_log_get_level.restype = ctypes.c_int
+        lib.rt_log.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rt_log_set_callback.argtypes = [LOG_CALLBACK, ctypes.c_void_p]
+        lib.rt_npy_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ]
+        lib.rt_npy_read_info.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.rt_npy_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t]
+        lib.rt_interruptible_token.restype = ctypes.c_void_p
+        lib.rt_interruptible_cancel.argtypes = [ctypes.c_void_p]
+        lib.rt_interruptible_cancelled.restype = ctypes.c_int
+        lib.rt_interruptible_cancelled.argtypes = [ctypes.c_void_p]
+        lib.rt_interruptible_check.restype = ctypes.c_int
+        lib.rt_interruptible_check.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not False
+
+
+def _lib():
+    lib = _load()
+    if lib is False:
+        raise RuntimeError("native core unavailable (no toolchain?)")
+    return lib
+
+
+def _check(code: int):
+    if code != 0:
+        raise RuntimeError(_lib().rt_last_error().decode())
+
+
+class NativeResources:
+    """Handle over the C++ resources container (ref: raft::resources)."""
+
+    def __init__(self, workspace_limit_bytes: int = 256 * 1024 * 1024, _h=None):
+        self._h = _h or _lib().rt_resources_create(workspace_limit_bytes)
+        if not self._h:
+            raise RuntimeError("resources creation failed")
+
+    def copy(self) -> "NativeResources":
+        return NativeResources(_h=_lib().rt_resources_copy(self._h))
+
+    def workspace_alloc(self, bytes_: int) -> int:
+        p = _lib().rt_workspace_alloc(self._h, bytes_)
+        if not p:
+            raise MemoryError(_lib().rt_last_error().decode())
+        return p
+
+    def workspace_free(self, ptr: int) -> None:
+        _check(_lib().rt_workspace_free(self._h, ptr))
+
+    @property
+    def workspace_used(self) -> int:
+        return _lib().rt_workspace_used(self._h)
+
+    @property
+    def workspace_high_water(self) -> int:
+        return _lib().rt_workspace_high_water(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and _LIB not in (None, False):
+            _LIB.rt_resources_destroy(h)
+
+
+def npy_write(path: str, arr: np.ndarray) -> None:
+    """Write through the native .npy serializer (byte-compatible with
+    np.save; ref: core/serialize.hpp serialize_mdspan)."""
+    arr = np.ascontiguousarray(arr)
+    dt = _DTYPES[arr.dtype]
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    _check(
+        _lib().rt_npy_write(
+            path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            shape, arr.ndim, dt,
+        )
+    )
+
+
+def npy_read(path: str) -> np.ndarray:
+    shape = (ctypes.c_int64 * 16)()
+    rank = ctypes.c_int()
+    dt = ctypes.c_int()
+    _check(_lib().rt_npy_read_info(path.encode(), shape, ctypes.byref(rank),
+                                   ctypes.byref(dt), 16))
+    sh = tuple(shape[i] for i in range(rank.value))
+    out = np.empty(sh, _DTYPES_INV[dt.value])
+    _check(_lib().rt_npy_read(path.encode(), out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes))
+    return out
+
+
+def log_set_level(level: int) -> None:
+    _lib().rt_log_set_level(level)
+
+
+def log(level: int, msg: str) -> None:
+    _lib().rt_log(level, msg.encode())
+
+
+_cb_keepalive = []
+
+
+def log_set_callback(fn) -> None:
+    """fn(level: int, msg: str) — mirrors the reference's callback sink
+    (core/detail/callback_sink.hpp) used for Python log integration."""
+    if fn is None:
+        _lib().rt_log_set_callback(LOG_CALLBACK(0), None)
+        return
+    cb = LOG_CALLBACK(lambda lvl, msg, _u: fn(lvl, msg.decode()))
+    _cb_keepalive.append(cb)
+    _lib().rt_log_set_callback(cb, None)
+
+
+class InterruptibleToken:
+    """(ref: core/interruptible.hpp; pylibraft common/interruptible.pyx)"""
+
+    def __init__(self):
+        self._tok = _lib().rt_interruptible_token()
+
+    def cancel(self) -> None:
+        _lib().rt_interruptible_cancel(self._tok)
+
+    @property
+    def cancelled(self) -> bool:
+        return bool(_lib().rt_interruptible_cancelled(self._tok))
+
+    def check(self) -> None:
+        code = _lib().rt_interruptible_check(self._tok)
+        if code != 0:
+            raise InterruptedError(_lib().rt_last_error().decode())

@@ -500,20 +500,50 @@ def search(
 # --------------------------------------------------------------------------
 
 def save(filename: str, index: Index, *, include_dataset: bool = True) -> None:
+    from raft_tpu.neighbors.vpq_dataset import VpqDataset
+
     arrays = {"graph": index.graph}
+    kind = "none"
     if include_dataset:
-        arrays["dataset"] = index.dataset
+        if isinstance(index.dataset, VpqDataset):
+            kind = "vpq"
+            arrays.update(
+                vq_centers=index.dataset.vq_centers,
+                pq_codebook=index.dataset.pq_codebook,
+                vq_codes=index.dataset.vq_codes,
+                pq_codes=index.dataset.pq_codes,
+            )
+        else:
+            kind = "dense"
+            arrays["dataset"] = index.dataset
     ser.save_tree(
         filename, "cagra", _SERIALIZATION_VERSION,
-        {"metric": index.metric, "include_dataset": int(include_dataset)},
+        {
+            "metric": index.metric,
+            "dataset_kind": kind,
+            "dim": int(index.dim),
+            # kept for format compatibility with earlier files
+            "include_dataset": int(include_dataset),
+        },
         arrays,
     )
 
 
 def load(filename: str, *, dataset: Optional[jax.Array] = None) -> Index:
+    from raft_tpu.neighbors.vpq_dataset import VpqDataset
+
     scalars, arrays = ser.load_tree(filename, "cagra", _SERIALIZATION_VERSION)
-    if scalars["include_dataset"]:
+    kind = scalars.get("dataset_kind", "dense" if scalars["include_dataset"] else "none")
+    if kind == "dense":
         ds = jnp.asarray(arrays["dataset"])
+    elif kind == "vpq":
+        ds = VpqDataset(
+            jnp.asarray(arrays["vq_centers"]),
+            jnp.asarray(arrays["pq_codebook"]),
+            jnp.asarray(arrays["vq_codes"]),
+            jnp.asarray(arrays["pq_codes"]),
+            int(scalars["dim"]),
+        )
     elif dataset is not None:
         ds = jnp.asarray(dataset, jnp.float32)
     else:

@@ -127,7 +127,16 @@ def build(
     resid = x - vq_centers[vq_codes]
     if pad:
         resid = jnp.pad(resid, ((0, 0), (0, pad)))
-    sub = jnp.transpose(resid.reshape(n, pq_dim, pq_len), (1, 0, 2))
+    # honor the PQ trainset-fraction knob (ref vpq_params bounds PQ training
+    # cost independently of the VQ pass)
+    pq_frac = params.pq_kmeans_trainset_fraction
+    n_pq = min(n, max(1 << params.pq_bits, int(n * pq_frac)))
+    if n_pq < n:
+        k_pq, k_sub = jax.random.split(k_pq)
+        pq_train = resid[jax.random.choice(k_sub, n, shape=(n_pq,), replace=False)]
+    else:
+        pq_train = resid
+    sub = jnp.transpose(pq_train.reshape(-1, pq_dim, pq_len), (1, 0, 2))
     codebook = _train_codebooks_lloyd(
         k_pq, sub, 1 << params.pq_bits, params.kmeans_n_iters
     )

@@ -81,6 +81,8 @@ def eigsh_lanczos(
         raise ValueError(f"k={k} > n={n}")
     m = m or min(n, max(2 * k + 8, 32))
     m = min(m, n)
+    if m < k:
+        raise ValueError(f"subspace size m={m} < k={k}")
     key = jax.random.PRNGKey(seed)
     k0, k1 = jax.random.split(key)
     v0 = jax.random.normal(k0, (n,), dtype)

@@ -129,8 +129,8 @@ def laplacian(adj: COO, *, normalized: bool = False) -> COO:
 
 def spmv_coo(coo: COO, x: jax.Array) -> jax.Array:
     """COO matrix-vector product (edge-parallel segment_sum)."""
-    n = coo.shape[0]
-    contrib = jnp.where(coo.valid, coo.data * x[jnp.clip(coo.cols, 0, n - 1)], 0)
+    n, m = coo.shape
+    contrib = jnp.where(coo.valid, coo.data * x[jnp.clip(coo.cols, 0, m - 1)], 0)
     return jax.ops.segment_sum(
         contrib, jnp.where(coo.valid, coo.rows, n), num_segments=n + 1
     )[:n]

@@ -1,0 +1,98 @@
+"""Benchmark harness: dataset formats, groundtruth, runner metrics, export,
+pareto plotting (mirrors raft-ann-bench's own smoke usage)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.bench import datasets, export, plot, runner
+
+
+@pytest.fixture(scope="module")
+def ds():
+    d = datasets.synthetic("sift-128-euclidean", scale=0.003, n_queries=50)
+    return datasets.generate_groundtruth(d, k=20)
+
+
+def test_bin_roundtrip(tmp_path, rng):
+    arr = rng.random((100, 16), dtype=np.float32)
+    p = str(tmp_path / "x.fbin")
+    datasets.write_bin(p, arr)
+    np.testing.assert_array_equal(datasets.read_bin(p), arr)
+    ids = rng.integers(0, 1000, (50, 10)).astype(np.int32)
+    p2 = str(tmp_path / "x.ibin")
+    datasets.write_bin(p2, ids)
+    np.testing.assert_array_equal(datasets.read_bin(p2), ids)
+
+
+def test_dataset_save_load(tmp_path, ds):
+    d = str(tmp_path / "ds")
+    datasets.save(ds, d)
+    back = datasets.load(d)
+    np.testing.assert_array_equal(back.base, ds.base)
+    np.testing.assert_array_equal(back.gt_neighbors, ds.gt_neighbors)
+
+
+def test_groundtruth_is_exact(ds):
+    import scipy.spatial.distance as sd
+
+    want = np.argsort(
+        sd.cdist(ds.queries[:10], ds.base, "sqeuclidean"), axis=1
+    )[:, :20]
+    np.testing.assert_array_equal(ds.gt_neighbors[:10], want)
+
+
+def test_run_case_metrics(ds):
+    results = runner.run_case(
+        ds, "raft_tpu_ivf_flat", {"n_lists": 32},
+        [{"n_probes": 4}, {"n_probes": 32}], k=10, warmup=1, iters=1,
+    )
+    assert len(results) == 2
+    r4, r32 = results
+    assert r32.recall >= r4.recall
+    assert r32.recall > 0.95  # all lists probed ⇒ near exact
+    assert r4.qps > 0 and r4.latency_ms > 0 and r4.build_time_s > 0
+
+
+def test_run_config_and_export(tmp_path, ds):
+    config = {
+        "algos": [
+            {"name": "raft_tpu_brute_force", "search_params": [{}]},
+            {
+                "name": "raft_tpu_ivf_pq",
+                "build_param": {"n_lists": 32, "pq_dim": 32},
+                "search_params": [{"n_probes": 8, "refine_ratio": 2}],
+            },
+        ]
+    }
+    results = runner.run_config(ds, config, k=10)
+    assert {r.algo for r in results} == {"raft_tpu_brute_force", "raft_tpu_ivf_pq"}
+    bf = [r for r in results if r.algo == "raft_tpu_brute_force"][0]
+    assert bf.recall == 1.0  # exact search matches groundtruth
+    jp = str(tmp_path / "r.json")
+    runner.save_results(results, jp)
+    back = export.from_json(jp)
+    assert back[0].algo == results[0].algo
+    cp = str(tmp_path / "r.csv")
+    export.to_csv(results, cp)
+    assert "recall" in open(cp).read()
+
+
+def test_pareto_frontier():
+    pts = [(0.5, 100), (0.6, 120), (0.7, 80), (0.9, 40), (0.8, 10)]
+    front = plot.pareto_frontier(pts)
+    assert (0.6, 120) in front and (0.9, 40) in front and (0.7, 80) in front
+    assert (0.5, 100) not in front  # dominated by (0.6, 120)
+    assert (0.8, 10) not in front   # dominated by (0.9, 40)
+
+
+def test_plot_writes_png(tmp_path, ds):
+    results = runner.run_case(
+        ds, "raft_tpu_ivf_flat", {"n_lists": 32},
+        [{"n_probes": p} for p in (2, 8, 32)], k=10, warmup=0, iters=1,
+    )
+    p = str(tmp_path / "f.png")
+    plot.plot_results(results, p)
+    assert os.path.getsize(p) > 1000

@@ -1,0 +1,140 @@
+"""pylibraft-compat layer: the reference's documented usage patterns run
+against raft_tpu.compat.pylibraft unmodified (mirrors pylibraft's quick-start
+snippets + test surfaces, docs/source/quick_start.md)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.compat.pylibraft import (
+    cluster,
+    common,
+    config,
+    distance,
+    matrix,
+    neighbors,
+    random,
+)
+
+
+@pytest.fixture(autouse=True)
+def numpy_outputs():
+    config.set_output_as("numpy")
+    yield
+    config.set_output_as("jax")
+
+
+def test_quickstart_pairwise_distance(rng):
+    # the pylibraft quick-start pattern: handle + in-place style call
+    n_samples, n_features = 500, 29
+    inp = rng.random((n_samples, n_features), dtype=np.float32)
+    handle = common.DeviceResources()
+    out = distance.pairwise_distance(inp, inp, metric="euclidean", handle=handle)
+    handle.sync()
+    import scipy.spatial.distance as sd
+
+    np.testing.assert_allclose(out, sd.cdist(inp, inp), rtol=1e-3, atol=1e-4)
+
+
+def test_fused_l2_nn_argmin(rng):
+    x = rng.random((100, 8), dtype=np.float32)
+    y = rng.random((30, 8), dtype=np.float32)
+    out = distance.fused_l2_nn_argmin(x, y)
+    d = ((x[:, None] - y[None, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(out, d.argmin(1))
+
+
+def test_select_k(rng):
+    scores = rng.random((10, 50), dtype=np.float32)
+    vals, idx = matrix.select_k(scores, 5)
+    np.testing.assert_array_equal(idx, np.argsort(scores, axis=1)[:, :5])
+
+
+def test_kmeans_surface(rng):
+    x = rng.random((400, 8), dtype=np.float32)
+    params = cluster.KMeansParams(n_clusters=5, seed=0)
+    centroids, inertia, n_iter = cluster.kmeans.fit(params, x)
+    assert centroids.shape == (5, 8)
+    assert inertia > 0 and n_iter >= 1
+    cost = cluster.kmeans.cluster_cost(x, centroids)
+    np.testing.assert_allclose(cost, inertia, rtol=1e-3)
+    newc = cluster.compute_new_centroids(x, centroids)
+    assert newc.shape == (5, 8)
+
+
+def test_neighbors_roundtrip(tmp_path, rng):
+    x = rng.random((2000, 16), dtype=np.float32)
+    q = rng.random((20, 16), dtype=np.float32)
+    _, gt = neighbors.brute_force.knn(x, q, 10)
+
+    params = neighbors.ivf_pq.IndexParams(n_lists=20, pq_dim=8)
+    index = neighbors.ivf_pq.build(params, x)
+    _, cand = neighbors.ivf_pq.search(
+        neighbors.ivf_pq.SearchParams(n_probes=20), index, q, 40
+    )
+    _, ref = neighbors.refine(x, q, cand, 10)
+    from raft_tpu.stats import neighborhood_recall
+
+    assert float(neighborhood_recall(ref, gt)) > 0.9
+
+    fn = str(tmp_path / "pq.idx")
+    neighbors.ivf_pq.save(fn, index)
+    loaded = neighbors.ivf_pq.load(fn)
+    _, i2 = neighbors.ivf_pq.search(
+        neighbors.ivf_pq.SearchParams(n_probes=20), loaded, q, 40
+    )
+    np.testing.assert_array_equal(cand, i2)
+
+
+def test_cagra_and_hnsw(tmp_path, rng):
+    x = rng.random((1500, 16), dtype=np.float32)
+    q = rng.random((20, 16), dtype=np.float32)
+    params = neighbors.cagra.IndexParams(
+        graph_degree=16, intermediate_graph_degree=32, build_algo="brute_force"
+    )
+    index = neighbors.cagra.build(params, x)
+    d, i = neighbors.cagra.search(neighbors.cagra.SearchParams(), index, q, 5)
+    assert i.shape == (20, 5)
+    h = neighbors.hnsw.from_cagra(index, str(tmp_path / "h.hnsw"))
+    d2, i2 = neighbors.hnsw.search(h, q, 5)
+    assert i2.shape == (20, 5)
+
+
+def test_rbc_and_eps(rng):
+    x = rng.random((800, 8), dtype=np.float32)
+    q = rng.random((10, 8), dtype=np.float32)
+    idx = neighbors.rbc.build(x, n_landmarks=20)
+    d, i = neighbors.rbc.query(idx, q, 5)
+    assert i.shape == (10, 5)
+    adj, deg = neighbors.eps_neighborhood(q, x, 0.5)
+    assert adj.shape == (10, 800)
+
+
+def test_rmat():
+    edges = random.rmat(4, 4, 1000, seed=1)
+    assert edges.shape == (1000, 2)
+    assert edges.max() < 16 and edges.min() >= 0
+
+
+def test_output_conversion_hook(rng):
+    import jax
+
+    x = rng.random((10, 4), dtype=np.float32)
+    config.set_output_as("jax")
+    out = distance.pairwise_distance(x, x)
+    assert isinstance(out, jax.Array)
+    config.set_output_as("numpy")
+    out = distance.pairwise_distance(x, x)
+    assert isinstance(out, np.ndarray)
+    seen = []
+    config.set_output_as(lambda a: (seen.append(1), np.asarray(a))[1])
+    distance.pairwise_distance(x, x)
+    assert seen
+
+
+def test_device_ndarray(rng):
+    a = rng.random((5, 3), dtype=np.float32)
+    d = common.device_ndarray(a)
+    assert d.shape == (5, 3) and d.dtype == np.float32
+    np.testing.assert_array_equal(d.copy_to_host(), a)
+    out = distance.pairwise_distance(d, d)
+    assert out.shape == (5, 5)

@@ -1,0 +1,81 @@
+"""Native C++ core: build, resources/workspace, npy interop with numpy,
+logger callback, interruptible (mirrors cpp/test/core/ — resources,
+serialization, interruptible suites)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def test_resources_workspace_lifecycle():
+    res = native.NativeResources(workspace_limit_bytes=1 << 20)
+    p = res.workspace_alloc(1000)
+    assert res.workspace_used >= 1000
+    # shallow copy shares the arena (reference resources semantics)
+    res2 = res.copy()
+    assert res2.workspace_used == res.workspace_used
+    res.workspace_free(p)
+    assert res.workspace_used == 0
+    assert res.workspace_high_water >= 1000
+
+
+def test_workspace_limit_enforced():
+    res = native.NativeResources(workspace_limit_bytes=1024)
+    with pytest.raises(MemoryError):
+        res.workspace_alloc(4096)
+
+
+def test_npy_write_numpy_reads(tmp_path, rng):
+    for arr in (
+        rng.random((7, 5)).astype(np.float32),
+        rng.integers(0, 255, (4, 3, 2)).astype(np.uint8),
+        rng.integers(-100, 100, 11).astype(np.int64),
+        rng.random(6).astype(np.float64),
+    ):
+        p = str(tmp_path / "a.npy")
+        native.npy_write(p, arr)
+        back = np.load(p)
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_numpy_write_native_reads(tmp_path, rng):
+    for arr in (
+        rng.random((9, 2)).astype(np.float32),
+        rng.integers(0, 1000, (3, 3)).astype(np.int32),
+    ):
+        p = str(tmp_path / "b.npy")
+        np.save(p, arr)
+        back = native.npy_read(p)
+        np.testing.assert_array_equal(back, arr)
+        assert back.dtype == arr.dtype
+
+
+def test_logger_callback():
+    got = []
+    native.log_set_callback(lambda lvl, msg: got.append((lvl, msg)))
+    native.log_set_level(4)  # debug
+    native.log(2, "warn message")
+    native.log(5, "trace filtered")  # above level → dropped
+    native.log_set_callback(None)
+    assert (2, "warn message") in got
+    assert all("trace" not in m for _, m in got)
+
+
+def test_interruptible():
+    tok = native.InterruptibleToken()
+    assert not tok.cancelled
+    tok.check()  # no-op
+    tok.cancel()
+    assert tok.cancelled
+    with pytest.raises(InterruptedError):
+        tok.check()
+    # flag cleared by the failed check (reference behavior)
+    assert not tok.cancelled
+    tok.check()
