@@ -1,24 +1,69 @@
 #!/usr/bin/env python
-"""Headline benchmark — prints ONE JSON line.
+"""Headline benchmark — prints ONE JSON line to stdout.
 
-Current headline (BASELINE config #2 ladder): brute-force kNN throughput on a
-SIFT-shaped synthetic workload (100k x 128 float32 dataset, 1k queries, k=10),
-run on the real TPU chip. ``vs_baseline`` compares our tiled+fused kNN
-against the naive unfused XLA formulation (full distance matrix materialized
-in HBM, then top_k) on the same hardware — the fusion/tiling win the
-reference's tiled_brute_force_knn exists to deliver
-(ref: cpp/include/raft/neighbors/detail/knn_brute_force.cuh:60).
+Headline (BASELINE config #4, the north star): IVF-PQ search QPS at
+recall>=0.95 on a DEEP-shaped synthetic workload (100k x 96 float32,
+1k queries, k=10).  The operating point is found by sweeping n_probes
+(with exact refinement) until recall >= 0.95 vs exact ground truth, then
+QPS is measured at that point.  ``vs_baseline`` is the speedup over exact
+tiled brute-force kNN on the same hardware at recall=1.0 — the
+compression/indexing win the reference's IVF-PQ exists to deliver
+(ref: cpp/include/raft/neighbors/detail/ivf_pq_search.cuh:588).
+
+Robustness: the TPU backend is probed in a *subprocess* with a hard
+timeout and retries — a hung or unavailable TPU runtime can never hang
+this script.  If the TPU is unreachable we pin the CPU backend, run a
+reduced-size workload, and still emit a parseable JSON line with
+``"platform": "cpu"`` so the failure mode is visible, not an rc=1.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+PROBE_TIMEOUT_S = 150
+PROBE_RETRIES = 3
+PROBE_BACKOFF_S = 10
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp, numpy as np; "
+    "d = jax.devices(); "
+    "x = jnp.ones((256, 256), jnp.float32); "
+    "print('PLATFORM=' + d[0].platform, float(np.asarray((x @ x).sum())))"
+)
+
+
+def probe_tpu() -> str | None:
+    """Return the accelerator platform name, or None if unusable."""
+    for attempt in range(PROBE_RETRIES):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            if out.returncode == 0 and "PLATFORM=" in out.stdout:
+                plat = out.stdout.split("PLATFORM=")[1].split()[0]
+                if plat != "cpu":
+                    return plat
+                return None  # only CPU visible — treat as fallback
+            err = (out.stderr or out.stdout).strip().splitlines()
+            print(f"probe attempt {attempt + 1}: rc={out.returncode} "
+                  f"{err[-1] if err else ''}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"probe attempt {attempt + 1}: timeout after "
+                  f"{PROBE_TIMEOUT_S}s", file=sys.stderr)
+        if attempt + 1 < PROBE_RETRIES:
+            time.sleep(PROBE_BACKOFF_S * (attempt + 1))
+    return None
 
 
 def timeit(fn, *args, warmup=2, iters=5):
+    import jax
+
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))
     t0 = time.perf_counter()
@@ -28,42 +73,96 @@ def timeit(fn, *args, warmup=2, iters=5):
     return (time.perf_counter() - t0) / iters
 
 
-def main():
+def main() -> None:
+    platform = probe_tpu()
+    import jax
+
+    if platform is None:
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+
+    import jax.numpy as jnp
+    import numpy as np
+
     from raft_tpu.core.resources import Resources
-    from raft_tpu.neighbors import brute_force
+    from raft_tpu.neighbors import brute_force, ivf_pq, refine
 
-    n, d, n_q, k = 100_000, 128, 1_000, 10
+    on_accel = platform != "cpu"
+    # Full DEEP-shaped workload on the accelerator; reduced on CPU fallback
+    # so the line is still produced in bounded time.
+    if on_accel:
+        n, d, n_q, k = 100_000, 96, 1_000, 10
+    else:
+        n, d, n_q, k = 20_000, 96, 500, 10
+
     rng = np.random.default_rng(0)
-    dataset = jnp.asarray(rng.random((n, d), dtype=np.float32))
-    queries = jnp.asarray(rng.random((n_q, d), dtype=np.float32))
+    dataset = jnp.asarray(rng.standard_normal((n, d), dtype=np.float32))
+    queries = jnp.asarray(rng.standard_normal((n_q, d), dtype=np.float32))
+    res = Resources(workspace_limit_bytes=1 << 30)
 
-    res = Resources(workspace_limit_bytes=512 * 1024 * 1024)
-
-    def ours(q):
+    # --- exact ground truth + brute-force baseline timing
+    def exact(q):
         return brute_force.knn(dataset, q, k, metric="sqeuclidean", res=res)
 
-    @jax.jit
-    def naive(q):
-        xx = jnp.sum(dataset * dataset, axis=1)
-        qq = jnp.sum(q * q, axis=1)
-        d2 = qq[:, None] + xx[None, :] - 2.0 * jnp.matmul(
-            q, dataset.T, precision=jax.lax.Precision.HIGHEST
-        )
-        v, i = jax.lax.top_k(-d2, k)
-        return -v, i
+    gt_d, gt_i = exact(queries)
+    gt_ids = np.asarray(gt_i)
+    t_exact = timeit(exact, queries)
 
-    t_ours = timeit(ours, queries)
-    t_naive = timeit(naive, queries)
+    # --- IVF-PQ build
+    params = ivf_pq.IndexParams(
+        n_lists=1024 if on_accel else 256,
+        metric="sqeuclidean",
+        pq_dim=d // 2,
+        pq_bits=8,
+        kmeans_n_iters=10,
+    )
+    t0 = time.perf_counter()
+    index = ivf_pq.build(params, dataset, res=res)
+    build_s = time.perf_counter() - t0
+
+    # --- find the operating point: smallest n_probes with recall >= 0.95
+    # (candidates k*4 then exact refine, the reference's standard recipe)
+    def make_search(n_probes):
+        sp = ivf_pq.SearchParams(n_probes=n_probes)
+
+        def fn(q):
+            cd, ci = ivf_pq.search(sp, index, q, k * 4, res=res)
+            return refine.refine(dataset, q, ci, k, metric="sqeuclidean", res=res)
+
+        return fn
+
+    chosen = None
+    for n_probes in (8, 16, 32, 64, 128, 256):
+        if n_probes > params.n_lists:
+            break
+        fn = make_search(n_probes)
+        _, ids = fn(queries)
+        hits = np.mean([
+            len(set(np.asarray(ids)[i]) & set(gt_ids[i])) / k for i in range(n_q)
+        ])
+        if hits >= 0.95:
+            chosen = (n_probes, float(hits), fn)
+            break
+        chosen = (n_probes, float(hits), fn)  # keep best-so-far operating point
+
+    n_probes, recall, fn = chosen
+    t_ours = timeit(fn, queries)
     qps = n_q / t_ours
-    naive_qps = n_q / t_naive
+    exact_qps = n_q / t_exact
 
     print(
         json.dumps(
             {
-                "metric": "bfknn_qps_sift100k_q1k_k10",
+                "metric": "ivf_pq_qps_deep100k_q1k_k10_recall95",
                 "value": round(qps, 1),
                 "unit": "queries/s",
-                "vs_baseline": round(qps / naive_qps, 3),
+                "vs_baseline": round(qps / exact_qps, 3),
+                "platform": platform,
+                "recall": round(recall, 4),
+                "n_probes": n_probes,
+                "build_s": round(build_s, 1),
+                "exact_qps": round(exact_qps, 1),
+                "n": n,
             }
         )
     )

@@ -20,6 +20,7 @@ from jax import lax
 
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import distance_matrix_tile
+from raft_tpu.core.trace import traced
 
 
 @dataclass
@@ -142,6 +143,7 @@ def _lloyd(x, centers0, weights, max_iter: int, tol: float, metric: str, tile: i
     return centers, jnp.sum(weights * best), n_iter
 
 
+@traced("kmeans.fit")
 def fit(
     params: KMeansParams,
     x: jax.Array,
@@ -193,6 +195,7 @@ def fit(
     return best
 
 
+@traced("kmeans.predict")
 def predict(
     centroids: jax.Array,
     x: jax.Array,
@@ -210,6 +213,7 @@ def predict(
     return labels
 
 
+@traced("kmeans.fit_predict")
 def fit_predict(
     params: KMeansParams,
     x: jax.Array,

@@ -31,6 +31,7 @@ from jax import lax
 
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import distance_matrix_tile
+from raft_tpu.core.trace import traced
 
 
 @dataclass
@@ -50,6 +51,7 @@ def _maybe_normalize(x: jax.Array, metric: str) -> jax.Array:
     return x
 
 
+@traced("kmeans_balanced.predict")
 def predict(
     centers: jax.Array,
     x: jax.Array,
@@ -156,6 +158,7 @@ def _fit_flat(
     return centers
 
 
+@traced("kmeans_balanced.fit")
 def fit(
     params: KMeansBalancedParams,
     x: jax.Array,
@@ -230,6 +233,7 @@ def fit(
     return centers
 
 
+@traced("kmeans_balanced.fit_predict")
 def fit_predict(
     params: KMeansBalancedParams,
     x: jax.Array,

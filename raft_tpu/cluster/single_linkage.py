@@ -26,6 +26,7 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.sparse.formats import COO
 from raft_tpu.sparse.neighbors import knn_graph
 from raft_tpu.sparse.solver import cross_component_nn, mst
+from raft_tpu.core.trace import traced
 
 
 @dataclass
@@ -39,6 +40,7 @@ class SingleLinkageOutput:
     n_clusters: int
 
 
+@traced("single_linkage.single_linkage")
 def single_linkage(
     x: jax.Array,
     *,

@@ -23,6 +23,7 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.ops.lanczos import eigsh_lanczos
 from raft_tpu.sparse.formats import COO
 from raft_tpu.sparse.linalg import laplacian, spmv_coo, weighted_degree
+from raft_tpu.core.trace import traced
 
 
 def _cluster_embedding(emb, n_clusters, seed, res):
@@ -55,6 +56,7 @@ def fit_embedding(
     return vecs[:, 1 : n_components + 1]
 
 
+@traced("spectral.partition")
 def partition(
     adj: COO,
     n_clusters: int,
@@ -92,6 +94,7 @@ def analyze_partition(
     return cut, jnp.min(sizes)
 
 
+@traced("spectral.modularity_maximization")
 def modularity_maximization(
     adj: COO,
     n_clusters: int,

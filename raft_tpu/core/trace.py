@@ -1,0 +1,66 @@
+"""Tracing / profiling ranges — nvtx parity for TPU.
+
+The reference wraps every major entry point in an NVTX scoped range with a
+dedicated ``raft`` domain (ref: cpp/include/raft/core/nvtx.hpp:49-82, used
+at e.g. neighbors/detail/ivf_pq_build.cuh:1687).  The TPU equivalents are
+
+- ``jax.profiler.TraceAnnotation`` — host-side Perfetto trace range, shows
+  up in ``jax.profiler.trace`` captures (the "domain" maps to the
+  ``raft_tpu.`` prefix);
+- ``jax.named_scope`` — attaches the name to the HLO ops traced under the
+  range so device-side work is attributable in the profile.
+
+Both are near-zero-cost when no profiler session is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Callable, Optional, TypeVar
+
+import jax
+
+DOMAIN = "raft_tpu"
+
+F = TypeVar("F", bound=Callable)
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Scoped profiler range ``raft_tpu.<name>`` (ref: nvtx.hpp range)."""
+    full = f"{DOMAIN}.{name}"
+    with jax.profiler.TraceAnnotation(full), jax.named_scope(name):
+        yield
+
+
+def traced(name: Optional[str] = None) -> Callable[[F], F]:
+    """Decorator form of :func:`trace_range` for public API entries."""
+
+    def deco(fn: F) -> F:
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with trace_range(label):
+                return fn(*args, **kwargs)
+
+        return wrapper  # type: ignore[return-value]
+
+    return deco
+
+
+@contextlib.contextmanager
+def profile(log_dir: str, *, host_tracer_level: int = 2):
+    """Capture a profiler trace of the enclosed block into ``log_dir``.
+
+    Thin wrapper over ``jax.profiler.trace`` so benches/tests don't import
+    jax.profiler directly (mirrors the reference gating NVTX behind a CMake
+    flag — here a no-op if RAFT_TPU_DISABLE_PROFILER is set).
+    """
+    if os.environ.get("RAFT_TPU_DISABLE_PROFILER"):
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

@@ -23,6 +23,7 @@ from jax import lax
 
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import distance_matrix_tile
+from raft_tpu.core.trace import traced
 
 
 def _tile_rows_for(res: Resources, n: int, m: int) -> int:
@@ -53,6 +54,7 @@ def _fused_nn_jit(x, y, metric: str, sqrt: bool, tile_rows: int):
     return vals, idxs
 
 
+@traced("fused_nn.fused_l2_nn")
 def fused_l2_nn(
     x: jax.Array,
     y: jax.Array,
@@ -68,6 +70,7 @@ def fused_l2_nn(
     return _fused_nn_jit(x, y, "sqeuclidean", sqrt, _tile_rows_for(res, y.shape[0], x.shape[0]))
 
 
+@traced("fused_nn.fused_l2_nn_argmin")
 def fused_l2_nn_argmin(
     x: jax.Array, y: jax.Array, *, res: Optional[Resources] = None
 ) -> jax.Array:
@@ -75,6 +78,7 @@ def fused_l2_nn_argmin(
     return fused_l2_nn(x, y, res=res)[1]
 
 
+@traced("fused_nn.fused_distance_nn_argmin")
 def fused_distance_nn_argmin(
     x: jax.Array,
     y: jax.Array,
@@ -95,6 +99,7 @@ def fused_distance_nn_argmin(
     return _fused_nn_jit(x, y, "cosine", False, _tile_rows_for(res, y.shape[0], x.shape[0]))[1]
 
 
+@traced("fused_nn.masked_l2_nn_argmin")
 def masked_l2_nn_argmin(
     x: jax.Array,
     y: jax.Array,

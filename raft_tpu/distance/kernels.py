@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.distance.pairwise import distance_matrix_tile
+from raft_tpu.core.trace import traced
 
 
 @dataclass
@@ -25,6 +26,7 @@ class KernelParams:
     coef0: float = 0.0
 
 
+@traced("kernels.gram_matrix")
 def gram_matrix(
     x: jax.Array,
     y: Optional[jax.Array] = None,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.core.trace import traced
 
 # Metric name → canonical key. Mirrors pylibraft's accepted names
 # (ref: python/pylibraft/pylibraft/distance/pairwise_distance.pyx DISTANCE_TYPES).
@@ -203,6 +204,7 @@ def _pairwise_jit(x, y, metric: str, p: float, tile_rows: int):
     return out.reshape(n_tiles * tile_rows, y.shape[0])[:m]
 
 
+@traced("pairwise.pairwise_distance")
 def pairwise_distance(
     x: jax.Array,
     y: Optional[jax.Array] = None,

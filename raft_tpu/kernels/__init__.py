@@ -1,0 +1,47 @@
+"""Pallas TPU kernels for the hot paths.
+
+The reference spends its hand-written-kernel budget on exactly these spots
+(SURVEY §2.2/§2.4/§2.8): k-selection (matrix/detail/select_radix.cuh,
+select_warpsort.cuh), fused distance+reduction (distance/fused_l2_nn-inl.cuh,
+spatial/knn/detail/fused_l2_knn-inl.cuh), and the IVF-PQ LUT scan
+(neighbors/detail/ivf_pq_compute_similarity-inl.cuh).  On TPU the XLA
+formulations of these are already strong, so each Pallas kernel here is an
+*alternative* code path behind a dispatch flag — A/B measured by
+``python -m raft_tpu.bench prims`` and enabled where it wins.
+
+Dispatch: ``use_pallas()`` consults RAFT_TPU_PALLAS:
+  - "0"    — never (pure XLA paths)
+  - "1"    — always (interpret mode off-TPU; for tests)
+  - "auto" — (default) on TPU backends only
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _platform() -> str:
+    return jax.devices()[0].platform
+
+
+def use_pallas() -> bool:
+    mode = os.environ.get("RAFT_TPU_PALLAS", "auto")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return _platform() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret=True off-TPU so kernels are testable on CPU
+    (SURVEY §5: sanitizer analog — interpret mode is also the OOB guard)."""
+    return _platform() != "tpu"
+
+
+from raft_tpu.kernels.fused_knn import fused_l2_topk  # noqa: E402
+from raft_tpu.kernels.fused_argmin import fused_l2_argmin  # noqa: E402
+
+__all__ = ["use_pallas", "interpret_mode", "fused_l2_topk", "fused_l2_argmin"]

@@ -1,0 +1,100 @@
+"""Fused L2 1-NN (distance + argmin) — the k-means inner loop.
+
+The reference never materializes the [n, n_clusters] distance matrix for
+predict: ``fusedL2NN`` computes the arg-min inside the pairwise-distance
+kernel (ref: cpp/include/raft/distance/fused_l2_nn-inl.cuh:79-194, used by
+cluster/detail/kmeans_balanced.cuh:83-164 ``predict_core``).
+
+TPU design: grid over (row-tile, center-tile), center-tile innermost; the
+running (min score, argmin id) pair lives in the revisited output block in
+VMEM.  Scores are partial sq-L2 (‖c‖²−2x·c — the ‖x‖² term is argmin-
+invariant) computed on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_WORST = float("inf")
+
+
+def _fused_argmin_kernel(x_ref, c_ref, cc_ref, val_ref, idx_ref, *, tile_c: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        val_ref[:] = jnp.full_like(val_ref, _WORST)
+        idx_ref[:] = jnp.zeros_like(idx_ref)
+
+    nt = x_ref.shape[0]
+    dots = jax.lax.dot_general(
+        x_ref[:], c_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    scores = cc_ref[0, :][None, :] - 2.0 * dots       # [nt, tile_c]
+
+    m = jnp.min(scores, axis=1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (nt, tile_c), 1)
+    first = jnp.min(jnp.where(scores == m[:, None], pos, tile_c), axis=1)
+    cand_i = j * tile_c + first
+
+    better = m < val_ref[:, 0]
+    val_ref[:, 0] = jnp.where(better, m, val_ref[:, 0])
+    idx_ref[:, 0] = jnp.where(better, cand_i, idx_ref[:, 0])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_rows", "tile_c", "interpret")
+)
+def fused_l2_argmin(
+    x: jax.Array,
+    centers: jax.Array,
+    center_sqnorms: jax.Array,
+    *,
+    tile_rows: int = 512,
+    tile_c: int = 128,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (partial scores [n], argmin ids [n]); scores are ‖c‖²−2x·c
+    (add ‖x‖² for true sq-L2 — the ranking is identical)."""
+    n, d = x.shape
+    d_pad = (-d) % 128
+    n_pad = (-n) % tile_rows
+    c_pad = (-centers.shape[0]) % tile_c
+    xp = jnp.pad(x.astype(jnp.float32), ((0, n_pad), (0, d_pad)))
+    cp = jnp.pad(centers.astype(jnp.float32), ((0, c_pad), (0, d_pad)))
+    cc = jnp.pad(center_sqnorms.astype(jnp.float32), (0, c_pad),
+                 constant_values=jnp.inf)[None, :]
+
+    grid = ((n + n_pad) // tile_rows, (centers.shape[0] + c_pad) // tile_c)
+    val, idx = pl.pallas_call(
+        functools.partial(_fused_argmin_kernel, tile_c=tile_c),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_rows, d + d_pad), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_c, d + d_pad), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_c), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_rows, 128), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_rows, 128), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n + n_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((n + n_pad, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xp, cp, cc)
+    return val[:n, 0], idx[:n, 0]

@@ -28,6 +28,7 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC, pairwise_distance
 from raft_tpu.neighbors._common import pack_padded_lists
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 _SUPPORTED = ("sqeuclidean", "euclidean", "haversine")
 
@@ -59,6 +60,7 @@ class BallCoverIndex:
         return self.landmarks.shape[1]
 
 
+@traced("ball_cover.build")
 def build(
     dataset: jax.Array,
     *,
@@ -122,6 +124,7 @@ def _query_jit(landmarks, list_vecs, list_index, queries,
     return v, i
 
 
+@traced("ball_cover.knn_query")
 def knn_query(
     index: BallCoverIndex,
     queries: jax.Array,
@@ -142,6 +145,7 @@ def knn_query(
     )
 
 
+@traced("ball_cover.all_knn_query")
 def all_knn_query(
     index: BallCoverIndex, k: int, *, n_probes: int = 0,
     res: Optional[Resources] = None,
@@ -156,6 +160,7 @@ def all_knn_query(
     return knn_query(index, jnp.asarray(data), k, n_probes=n_probes, res=res)
 
 
+@traced("ball_cover.eps_nn")
 def eps_nn(
     index: BallCoverIndex,
     queries: jax.Array,

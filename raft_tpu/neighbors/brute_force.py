@@ -26,6 +26,7 @@ from raft_tpu.core import serialize as ser
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, distance_matrix_tile
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 _SERIALIZATION_VERSION = 1
 
@@ -88,6 +89,7 @@ def _tiled_knn(
     return vals, idx
 
 
+@traced("brute_force.knn")
 def knn(
     dataset: jax.Array,
     queries: jax.Array,
@@ -158,11 +160,13 @@ class Index:
         return self.dataset.shape[1]
 
 
+@traced("brute_force.build")
 def build(dataset: jax.Array, *, metric: str = "sqeuclidean", res=None) -> Index:
     """(ref: neighbors/brute_force.cuh build)"""
     return Index(dataset, metric)
 
 
+@traced("brute_force.search")
 def search(
     index: Index,
     queries: jax.Array,

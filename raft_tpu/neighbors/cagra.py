@@ -45,6 +45,7 @@ from raft_tpu.neighbors import brute_force, ivf_pq, nn_descent
 from raft_tpu.neighbors._common import sorted_id_dedup
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 _SERIALIZATION_VERSION = 1
 
@@ -98,6 +99,7 @@ class Index:
         return self.graph.shape[1]
 
 
+@traced("cagra.compress")
 def compress(index: Index, params=None, *, res: Optional[Resources] = None) -> Index:
     """Replace the dense dataset with a VPQ-compressed one; search then
     decodes candidates on the fly and distances become approximate
@@ -193,6 +195,7 @@ def _merge_forward_reverse(forward: jax.Array, reverse: jax.Array) -> jax.Array:
     return out
 
 
+@traced("cagra.optimize")
 def optimize(
     knn_graph: jax.Array,
     out_degree: int,
@@ -217,6 +220,7 @@ def optimize(
 # build (ref: detail/cagra/cagra_build.cuh)
 # --------------------------------------------------------------------------
 
+@traced("cagra.build")
 def build(
     params: IndexParams,
     dataset: jax.Array,
@@ -440,6 +444,7 @@ def _search_jit(
     return vals.reshape(-1, k)[:q], idx.reshape(-1, k)[:q]
 
 
+@traced("cagra.search")
 def search(
     params: SearchParams,
     index: Index,

@@ -25,6 +25,7 @@ import numpy as np
 
 from raft_tpu.core.resources import Resources
 from raft_tpu.neighbors import cagra
+from raft_tpu.core.trace import traced
 
 
 def serialize_to_hnswlib(filename: str, index: "cagra.Index") -> None:

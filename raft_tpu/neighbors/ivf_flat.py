@@ -46,6 +46,7 @@ from raft_tpu.neighbors._common import (
     unpack_lists,
 )
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 _SERIALIZATION_VERSION = 1
 
@@ -123,6 +124,7 @@ def _pack_lists(
     )
 
 
+@traced("ivf_flat.build")
 def build(
     params: IndexParams,
     dataset: jax.Array,
@@ -167,6 +169,7 @@ def build(
     return index
 
 
+@traced("ivf_flat.extend")
 def extend(
     index: Index,
     new_vectors: jax.Array,
@@ -270,6 +273,7 @@ def _search_jit(
     )
 
 
+@traced("ivf_flat.search")
 def search(
     params: SearchParams,
     index: Index,

@@ -57,6 +57,7 @@ from raft_tpu.neighbors._common import (
     unpack_lists,
 )
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 _SERIALIZATION_VERSION = 1
 
@@ -250,6 +251,7 @@ def _pack_code_lists(codes: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_l
     return jnp.asarray(list_codes), jnp.asarray(list_index), jnp.asarray(sizes)
 
 
+@traced("ivf_pq.build")
 def build(
     params: IndexParams,
     dataset: jax.Array,
@@ -339,6 +341,7 @@ def build(
     return index
 
 
+@traced("ivf_pq.extend")
 def extend(
     index: Index,
     new_vectors: jax.Array,
@@ -502,6 +505,7 @@ def _search_jit(
     )
 
 
+@traced("ivf_pq.search")
 def search(
     params: SearchParams,
     index: Index,

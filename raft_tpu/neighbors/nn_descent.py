@@ -43,6 +43,7 @@ from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors._common import sorted_id_dedup
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 
 @dataclass
@@ -155,6 +156,7 @@ def _nn_descent_iter(key, dataset, graph_ids, graph_dists, metric: str,
     return graph_ids, graph_dists, updates
 
 
+@traced("nn_descent.build")
 def build(
     params: IndexParams,
     dataset: jax.Array,

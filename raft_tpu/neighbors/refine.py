@@ -26,6 +26,7 @@ import numpy as np
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.core.trace import traced
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
@@ -54,6 +55,7 @@ def _refine_jit(dataset, queries, candidates, k: int, metric: str):
     return v, i
 
 
+@traced("refine.refine")
 def refine(
     dataset: jax.Array,
     queries: jax.Array,

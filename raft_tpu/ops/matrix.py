@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from raft_tpu.core.trace import traced
 
 
 def _min_identity(dtype):
@@ -38,6 +39,7 @@ def _max_identity(dtype):
     return jnp.array(jnp.iinfo(dtype).min, dtype)
 
 
+@traced("matrix.select_k")
 def select_k(
     scores: jax.Array,
     k: int,

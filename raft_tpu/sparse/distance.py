@@ -22,6 +22,7 @@ import numpy as np
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, pairwise_distance
 from raft_tpu.sparse.formats import CSR
+from raft_tpu.core.trace import traced
 
 
 def _densify_rows(csr: CSR, start: int, count: int) -> jax.Array:
@@ -36,6 +37,7 @@ def _densify_rows(csr: CSR, start: int, count: int) -> jax.Array:
     return out[:count]
 
 
+@traced("distance.pairwise_distance_sparse")
 def pairwise_distance_sparse(
     a: CSR,
     b: CSR,

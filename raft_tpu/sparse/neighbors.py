@@ -15,8 +15,10 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.ops.matrix import merge_topk, select_k
 from raft_tpu.sparse.distance import _densify_rows
 from raft_tpu.sparse.formats import COO, CSR
+from raft_tpu.core.trace import traced
 
 
+@traced("neighbors.brute_force_knn")
 def brute_force_knn(
     dataset: CSR,
     queries: CSR,
@@ -61,6 +63,7 @@ def brute_force_knn(
     return vals, idx
 
 
+@traced("neighbors.knn_graph")
 def knn_graph(
     dataset,
     k: int,

@@ -23,6 +23,7 @@ from jax import lax
 
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.sparse.formats import COO
+from raft_tpu.core.trace import traced
 
 _INT_MAX = jnp.iinfo(jnp.int32).max
 
@@ -106,6 +107,7 @@ def _mst_jit(rows, cols, weights, valid, n: int):
     return comp, chosen
 
 
+@traced("solver.mst")
 def mst(
     graph: COO, *, res: Optional[Resources] = None
 ) -> Tuple[COO, jax.Array, jax.Array]:
@@ -157,6 +159,7 @@ def _cc_jit(rows, cols, valid, n: int):
     return comp
 
 
+@traced("solver.connected_components")
 def connected_components(graph: COO) -> jax.Array:
     """Component labels (min vertex id per component) by label propagation +
     pointer jumping (the reference reaches this via its MST coloring;
@@ -185,6 +188,7 @@ def _cross_nn_jit(x, labels):
     return j, jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0]
 
 
+@traced("solver.cross_component_nn")
 def cross_component_nn(
     x: jax.Array,
     labels: jax.Array,
