@@ -85,7 +85,8 @@ def main() -> None:
     import numpy as np
 
     from raft_tpu.core.resources import Resources
-    from raft_tpu.neighbors import brute_force, ivf_pq, refine
+    from raft_tpu.neighbors import brute_force, ivf_pq
+    from raft_tpu.neighbors.refine import refine as refine_fn
 
     on_accel = platform != "cpu"
     # Full DEEP-shaped workload on the accelerator; reduced on CPU fallback
@@ -127,7 +128,7 @@ def main() -> None:
 
         def fn(q):
             cd, ci = ivf_pq.search(sp, index, q, k * 4, res=res)
-            return refine.refine(dataset, q, ci, k, metric="sqeuclidean", res=res)
+            return refine_fn(dataset, q, ci, k, metric="sqeuclidean", res=res)
 
         return fn
 

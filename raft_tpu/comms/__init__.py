@@ -22,19 +22,43 @@ from raft_tpu.comms.comms import (
     perform_test_comms_allreduce,
     perform_test_comms_bcast,
     perform_test_comms_allgather,
+    perform_test_comms_allgatherv,
     perform_test_comms_reduce,
     perform_test_comms_reducescatter,
     perform_test_comms_send_recv,
+    perform_test_comm_split,
+)
+from raft_tpu.comms.bootstrap import (
+    CommsCluster,
+    initialize,
+    shutdown,
+    is_initialized,
+    global_mesh,
+    get_raft_comm_state,
+    local_handle,
+    process_index,
+    process_count,
 )
 
 __all__ = [
     "Comms",
     "make_mesh",
     "local_comms",
+    "CommsCluster",
+    "initialize",
+    "shutdown",
+    "is_initialized",
+    "global_mesh",
+    "get_raft_comm_state",
+    "local_handle",
+    "process_index",
+    "process_count",
     "perform_test_comms_allreduce",
     "perform_test_comms_bcast",
     "perform_test_comms_allgather",
+    "perform_test_comms_allgatherv",
     "perform_test_comms_reduce",
     "perform_test_comms_reducescatter",
     "perform_test_comms_send_recv",
+    "perform_test_comm_split",
 ]

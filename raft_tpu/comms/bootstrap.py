@@ -1,0 +1,207 @@
+"""Multi-process (multi-controller) bootstrap — the raft-dask ``Comms`` analog.
+
+The reference bootstraps one process per GPU: a Dask client creates an NCCL
+unique id, broadcasts it to every worker, each worker initializes its NCCL
+rank and injects a ``std_comms`` into its handle
+(ref: python/raft-dask/raft_dask/common/comms.py:39-243,
+cpp/include/raft/comms/std_comms.hpp:26-187).
+
+TPU-native re-expression: the *entire* uid-exchange/transport-construction
+machinery collapses into ``jax.distributed.initialize(coordinator, n, rank)``
+— the coordinator address IS the nccl-uid analog — after which
+``jax.devices()`` shows the global device set and a ``Mesh`` over it makes
+XLA lower collectives onto ICI (in-slice) / DCN (cross-slice). This module
+keeps the raft-dask lifecycle surface (session ids, ``init``/``destroy``,
+per-session worker state, ``local_handle``) so orchestration code ports
+verb-for-verb.
+
+On CPU (tests / simulation) cross-process collectives use jaxlib's gloo
+backend; on TPU the platform's native transport is used automatically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.core.resources import Resources
+
+_init_lock = threading.Lock()
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Tuple[int, ...]] = None,
+    cpu_collectives: str = "gloo",
+) -> None:
+    """Join the multi-controller runtime (idempotent).
+
+    ``coordinator_address`` plays the role of the NCCL unique id in the
+    reference's bootstrap (ref: raft-dask comms.py:137-150 nccl uid create +
+    broadcast): every process that dials the same coordinator becomes a rank.
+    With all arguments None, cluster env vars (SLURM/TPU metadata) are used,
+    matching ``jax.distributed.initialize()``'s auto-detection.
+    """
+    global _initialized
+    import jax
+
+    with _init_lock:
+        if _initialized:
+            return
+        # CPU cross-process collectives need an explicit implementation.
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" or (
+            jax.config.jax_platforms == "cpu"
+        ):
+            jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = list(local_device_ids)
+        jax.distributed.initialize(**kwargs)
+        _initialized = True
+
+
+def shutdown() -> None:
+    global _initialized
+    import jax
+
+    with _init_lock:
+        if _initialized:
+            jax.distributed.shutdown()
+            _initialized = False
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def global_mesh(
+    axis_names: Tuple[str, ...] = ("data",),
+    shape: Optional[Tuple[int, ...]] = None,
+):
+    """Mesh over the *global* device set (all processes).
+
+    The analog of building one std_comms spanning every worker's GPU
+    (ref: raft-dask comms.py:172-212 _func_init_all on every worker).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+# ---- per-session worker state (ref: raft-dask comms.py:247-268) -----------
+
+_sessions: Dict[str, dict] = {}
+_sessions_lock = threading.Lock()
+
+
+def get_raft_comm_state(session_id: str) -> dict:
+    """Per-session state dict, created on first access on this process —
+    mirrors raft-dask's worker-side session registry
+    (ref: raft-dask/common/comms.py:247 get_raft_comm_state)."""
+    with _sessions_lock:
+        return _sessions.setdefault(session_id, {})
+
+
+def local_handle(session_id: str) -> Optional[Resources]:
+    """The session's Resources on this process, or None if not init'd
+    (ref: raft-dask/common/comms.py:262 local_handle)."""
+    return get_raft_comm_state(session_id).get("handle")
+
+
+@dataclass
+class CommsCluster:
+    """raft-dask ``Comms``-surface lifecycle object.
+
+    Owns a session id; ``init()`` joins the multi-controller runtime (if
+    needed), builds the global mesh, constructs the collective facade and
+    injects it into a per-session ``Resources`` handle retrievable via
+    ``local_handle(session_id)`` — the same contract raft-dask gives Dask
+    workers (ref: python/raft-dask/raft_dask/common/comms.py:86-243).
+
+    ``destroy()`` drops the session state (the runtime itself is shared and
+    shut down via ``shutdown()``, like NCCL comms vs the Dask cluster).
+    """
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    axis_names: Tuple[str, ...] = ("data",)
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    session_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+    def __post_init__(self):
+        self._mesh = None
+        self._comms: Optional[Comms] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self) -> "CommsCluster":
+        if self.num_processes is not None and self.num_processes > 1:
+            initialize(
+                self.coordinator_address, self.num_processes, self.process_id
+            )
+        self._mesh = global_mesh(self.axis_names, self.mesh_shape)
+        self._comms = Comms(self._mesh, self.axis_names[0])
+        state = get_raft_comm_state(self.session_id)
+        handle = Resources(mesh=self._mesh)
+        handle.set_comms(self._comms)
+        state["handle"] = handle
+        state["nranks"] = self._comms.get_size()
+        state["rank"] = process_index() if is_initialized() else 0
+        return self
+
+    def destroy(self) -> None:
+        with _sessions_lock:
+            _sessions.pop(self.session_id, None)
+        self._mesh = None
+        self._comms = None
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            raise RuntimeError("CommsCluster not initialized; call init()")
+        return self._mesh
+
+    @property
+    def comms(self) -> Comms:
+        if self._comms is None:
+            raise RuntimeError("CommsCluster not initialized; call init()")
+        return self._comms
+
+    @property
+    def handle(self) -> Resources:
+        h = local_handle(self.session_id)
+        if h is None:
+            raise RuntimeError("CommsCluster not initialized; call init()")
+        return h

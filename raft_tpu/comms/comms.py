@@ -165,6 +165,16 @@ def _run(comms: Comms, fn, out_specs=P()):
     return f()
 
 
+def _local(x: jax.Array) -> np.ndarray:
+    """Concatenate this process's addressable shards.
+
+    In multi-process SPMD the global array spans non-addressable devices;
+    each rank validates its own shards (the reference's self-tests likewise
+    check per-rank results — comms/detail/test.hpp:41)."""
+    shards = sorted(x.addressable_shards, key=lambda s: s.index)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
 def perform_test_comms_allreduce(comms: Comms) -> bool:
     n = comms.get_size()
 
@@ -172,7 +182,7 @@ def perform_test_comms_allreduce(comms: Comms) -> bool:
         v = comms.allreduce(jnp.ones(()))
         return (v == n).astype(jnp.int32)[None]
 
-    return bool(np.all(np.asarray(_run(comms, body, P(comms.axis)))))
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
 
 
 def perform_test_comms_bcast(comms: Comms, root: int = 0) -> bool:
@@ -182,7 +192,7 @@ def perform_test_comms_bcast(comms: Comms, root: int = 0) -> bool:
         got = comms.bcast(mine, root)
         return (got == 42.0).astype(jnp.int32)[None]
 
-    return bool(np.all(np.asarray(_run(comms, body, P(comms.axis)))))
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
 
 
 def perform_test_comms_allgather(comms: Comms) -> bool:
@@ -193,7 +203,7 @@ def perform_test_comms_allgather(comms: Comms) -> bool:
         g = comms.allgather(rank[None].astype(jnp.float32))
         return jnp.all(g == jnp.arange(n, dtype=jnp.float32)).astype(jnp.int32)[None]
 
-    return bool(np.all(np.asarray(_run(comms, body, P(comms.axis)))))
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
 
 
 def perform_test_comms_reduce(comms: Comms, root: int = 0) -> bool:
@@ -203,7 +213,7 @@ def perform_test_comms_reduce(comms: Comms, root: int = 0) -> bool:
         v = comms.reduce(jnp.ones(()), root)
         return (v == n).astype(jnp.int32)[None]
 
-    return bool(np.all(np.asarray(_run(comms, body, P(comms.axis)))))
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
 
 
 def perform_test_comms_reducescatter(comms: Comms) -> bool:
@@ -214,7 +224,7 @@ def perform_test_comms_reducescatter(comms: Comms) -> bool:
         v = comms.reducescatter(x)
         return jnp.all(v == n).astype(jnp.int32)[None]
 
-    return bool(np.all(np.asarray(_run(comms, body, P(comms.axis)))))
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
 
 
 def perform_test_comms_send_recv(comms: Comms) -> bool:
@@ -226,4 +236,44 @@ def perform_test_comms_send_recv(comms: Comms) -> bool:
         expect = jnp.mod(rank.astype(jnp.float32) - 1, n)
         return (got == expect).astype(jnp.int32)[None]
 
-    return bool(np.all(np.asarray(_run(comms, body, P(comms.axis)))))
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
+
+
+def perform_test_comms_allgatherv(comms: Comms, max_len: int = 4) -> bool:
+    """Rank r contributes (r+1) valid elements of value r, padded to max_len;
+    every rank must reconstruct the full ragged set (ref: comms_t::allgatherv,
+    comms/comms_test.hpp test_collective_allgatherv)."""
+    n = comms.get_size()
+
+    def body():
+        rank = comms.get_rank()
+        length = rank + 1
+        vals = jnp.where(
+            jnp.arange(max_len) < length, rank.astype(jnp.float32), jnp.nan
+        )
+        g, lens = comms.allgatherv(vals, length[None])
+        ok = jnp.ones((), jnp.int32)
+        for r in range(n):
+            valid = jnp.where(jnp.arange(max_len) < lens[r, 0], g[r], float(r))
+            ok = ok & jnp.all(valid == float(r)).astype(jnp.int32)
+            ok = ok & (lens[r, 0] == r + 1).astype(jnp.int32)
+        return ok[None]
+
+    return bool(np.all(_local(_run(comms, body, P(comms.axis)))))
+
+
+def perform_test_comm_split(comms: Comms, axis: str) -> bool:
+    """Collectives on a split sub-communicator reduce only over that axis
+    (ref: comms_t::comm_split + sub_comms resource)."""
+    sub = comms.comm_split(axis)
+    n_sub = sub.get_size()
+    specs = P(*comms.mesh.axis_names)
+
+    def body():
+        v = sub.allreduce(jnp.ones(()))
+        out = (v == n_sub).astype(jnp.int32)
+        for _ in comms.mesh.axis_names:
+            out = out[None]
+        return out
+
+    return bool(np.all(_local(_run(comms, body, specs))))
