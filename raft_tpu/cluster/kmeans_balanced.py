@@ -203,27 +203,42 @@ def fit(
             load = np.where(fine_k > 1, counts / np.maximum(fine_k, 1), np.inf)
             fine_k[np.argmin(load)] -= 1
 
-    # one compiled fine-fit over a padded member buffer per mesocluster;
+    # one compiled, vmapped fine-fit over a padded member buffer for ALL
+    # mesoclusters at once (one dispatch instead of n_meso sequential fits);
     # padding repeats the mesocluster's own members (weight 0) so random
     # seeds/teleports can never land outside the partition
     max_members = int(counts.max())
     max_fine = int(fine_k.max())
-    x_np = np.asarray(x)
-    all_centers = []
-    for m in range(n_meso):
+    occ = np.nonzero((counts > 0) & (fine_k > 0))[0]
+    sel = np.empty((len(occ), max_members), np.int64)
+    wts = np.zeros((len(occ), max_members), np.float32)
+    for row, m in enumerate(occ):
         members = np.nonzero(meso_labels == m)[0]
-        if len(members) == 0 or fine_k[m] == 0:
-            continue
         pad = max_members - len(members)
-        sel = np.concatenate([members, members[np.arange(pad) % len(members)]])
-        w = np.concatenate([np.ones(len(members), np.float32), np.zeros(pad, np.float32)])
-        sub = jnp.asarray(x_np[sel])
-        centers_m = _fit_flat(
-            jax.random.fold_in(k_fine, m), sub, max_fine, params.n_iters,
-            jnp.asarray(w), metric,
+        sel[row, : len(members)] = members
+        sel[row, len(members):] = members[np.arange(pad) % len(members)]
+        wts[row, : len(members)] = 1.0
+    keys = jax.vmap(lambda m: jax.random.fold_in(k_fine, m))(jnp.asarray(occ))
+    vfit = jax.vmap(
+        lambda kk, sub, w: _fit_flat(kk, sub, max_fine, params.n_iters, w, metric)
+    )
+    # chunk the vmap so peak memory stays inside the workspace budget even
+    # when one mesocluster holds most of the trainset (member buffer +
+    # per-iteration distance tile per vmapped lane)
+    per_meso = 4 * max_members * (x.shape[1] + max_fine)
+    chunk = int(np.clip(res.workspace_limit_bytes // max(per_meso, 1), 1, len(occ)))
+    parts = []
+    for s in range(0, len(occ), chunk):
+        idx = jnp.asarray(sel[s : s + chunk])
+        parts.append(
+            np.asarray(
+                vfit(keys[s : s + chunk], x[idx], jnp.asarray(wts[s : s + chunk]))
+            )
         )
-        all_centers.append(np.asarray(centers_m)[: int(fine_k[m])])
-    centers = jnp.asarray(np.concatenate(all_centers, axis=0))
+    fine_np = np.concatenate(parts)
+    centers = jnp.asarray(
+        np.concatenate([fine_np[r, : int(fine_k[m])] for r, m in enumerate(occ)])
+    )
     assert centers.shape[0] == n_clusters, (centers.shape, n_clusters)
 
     # final balancing passes over the full trainset (ref: :1016-1043)

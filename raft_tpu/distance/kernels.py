@@ -1,18 +1,23 @@
 """Gram-matrix (SVM-style) kernels (ref: cpp/include/raft/distance/kernels.cuh,
-detail/kernels/ — linear / polynomial / tanh / RBF over dense inputs).
+detail/kernels/ — linear / polynomial / tanh / RBF over dense AND CSR inputs).
 
 All four are matmul + elementwise epilogue → pure MXU + fused VPU on TPU.
+CSR inputs route the inner product through the feature-tiled sparse Gram
+(bounded memory in the feature dimension; see sparse/distance.py), matching
+the reference's CSR kernel specializations
+(detail/kernels/gram_matrix.cuh evaluate(csr_matrix_view...)).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
 
 from raft_tpu.distance.pairwise import distance_matrix_tile
+from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.core.trace import traced
 
 
@@ -26,23 +31,50 @@ class KernelParams:
     coef0: float = 0.0
 
 
-@traced("kernels.gram_matrix")
-def gram_matrix(
-    x: jax.Array,
-    y: Optional[jax.Array] = None,
-    params: Optional[KernelParams] = None,
-) -> jax.Array:
-    params = params or KernelParams()
-    x = jnp.asarray(x, jnp.float32)
-    y = x if y is None else jnp.asarray(y, jnp.float32)
+def _is_csr(x) -> bool:
+    return hasattr(x, "indptr") and hasattr(x, "indices")
+
+
+def _epilogue(ip, params: KernelParams, d2=None):
     k = params.kernel
     if k == "linear":
-        return x @ y.T
+        return ip
     if k == "polynomial":
-        return (params.gamma * (x @ y.T) + params.coef0) ** params.degree
+        return (params.gamma * ip + params.coef0) ** params.degree
     if k == "tanh":
-        return jnp.tanh(params.gamma * (x @ y.T) + params.coef0)
+        return jnp.tanh(params.gamma * ip + params.coef0)
     if k == "rbf":
-        d2 = distance_matrix_tile(x, y, "sqeuclidean")
         return jnp.exp(-params.gamma * d2)
     raise ValueError(f"unknown kernel {k!r}")
+
+
+@traced("kernels.gram_matrix")
+def gram_matrix(
+    x,
+    y=None,
+    params: Optional[KernelParams] = None,
+    *,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Kernel Gram matrix over dense arrays or CSR matrices
+    (ref: distance/kernels.cuh GramMatrix::evaluate — dense & CSR overloads)."""
+    params = params or KernelParams()
+    if _is_csr(x):
+        from raft_tpu.sparse.distance import _sparse_gram, row_norms_sq
+
+        res = ensure(res)
+        y = x if y is None else y
+        if not _is_csr(y):
+            raise ValueError("CSR gram requires both operands CSR")
+        ip = _sparse_gram(x, y, res)
+        if params.kernel == "rbf":
+            n2x, n2y = row_norms_sq(x), row_norms_sq(y)
+            d2 = jnp.maximum(n2x[:, None] + n2y[None, :] - 2.0 * ip, 0.0)
+            return _epilogue(ip, params, d2)
+        return _epilogue(ip, params)
+
+    x = jnp.asarray(x, jnp.float32)
+    y = x if y is None else jnp.asarray(y, jnp.float32)
+    if params.kernel == "rbf":
+        return _epilogue(None, params, distance_matrix_tile(x, y, "sqeuclidean"))
+    return _epilogue(x @ y.T, params)

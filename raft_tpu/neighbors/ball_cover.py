@@ -84,7 +84,7 @@ def build(
     dists = _dist(x, landmarks, base)
     labels = jnp.argmin(dists, axis=1).astype(jnp.int32)
     member_d = jnp.take_along_axis(dists, labels[:, None], axis=1)[:, 0]
-    list_vecs, list_index, sizes = pack_padded_lists(
+    list_vecs, list_index, sizes, _ = pack_padded_lists(
         np.asarray(x), np.arange(n, dtype=np.int32), np.asarray(labels), L
     )
     radii = jnp.zeros(L, jnp.float32).at[labels].max(member_d)

@@ -42,6 +42,8 @@ from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
     coarse_select,
     invalid_mask,
+    default_max_cap,
+    merge_split_lists,
     pack_padded_lists,
     unpack_lists,
 )
@@ -111,8 +113,15 @@ class Index:
 def _pack_lists(
     dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int, metric: str
 ):
-    """Pack into the padded [n_lists, cap, dim] layout + per-slot norms."""
-    list_data, list_index, sizes = pack_padded_lists(dataset, ids, labels, n_lists)
+    """Pack into the padded [n_lists', cap, dim] layout + per-slot norms.
+
+    Oversized lists are split with duplicated centroids (skew-bounded cap;
+    see _common.split_oversized_lists) — returns center_map so the caller
+    expands its centroid rows."""
+    list_data, list_index, sizes, center_map = pack_padded_lists(
+        dataset, ids, labels, n_lists,
+        max_cap=default_max_cap(dataset.shape[0], n_lists),
+    )
     norms = np.full(list_index.shape, np.inf, np.float32)
     valid = list_index >= 0
     norms[valid] = (list_data.astype(np.float32) ** 2).sum(-1)[valid]
@@ -121,6 +130,7 @@ def _pack_lists(
         jnp.asarray(list_index),
         jnp.asarray(sizes),
         jnp.asarray(norms),
+        center_map,
     )
 
 
@@ -196,17 +206,22 @@ def extend(
     if new_indices is None:
         new_indices = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
 
-    # merge with existing content host-side, then re-pack
+    # merge with existing content host-side, then re-pack; split shards from
+    # a previous pack are first merged back to their parent list so repeated
+    # extend() calls cannot inflate n_lists
     old_rows, old_ids, old_labels = unpack_lists(
         np.asarray(index.list_data), np.asarray(index.list_index)
     )
     all_rows = np.concatenate([old_rows, np.asarray(new_vectors)])
     all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
     all_labels = np.concatenate([old_labels, np.asarray(labels)])
-    list_data, list_index, list_sizes, list_norms = _pack_lists(
-        all_rows, all_ids, all_labels, index.n_lists, index.metric
+    uniq, all_labels = merge_split_lists(np.asarray(index.centers), all_labels)
+    base_centers = index.centers[jnp.asarray(uniq)]
+    list_data, list_index, list_sizes, list_norms, center_map = _pack_lists(
+        all_rows, all_ids, all_labels, len(uniq), index.metric
     )
-    return Index(index.metric, index.centers, list_data, list_index, list_sizes, list_norms)
+    centers = base_centers[jnp.asarray(center_map)]
+    return Index(index.metric, centers, list_data, list_index, list_sizes, list_norms)
 
 
 @functools.partial(jax.jit, static_argnames=("n_probes", "k", "metric", "query_tile"))

@@ -30,7 +30,10 @@ def built(data):
 
 def test_build_properties(built, data):
     x, _ = data
-    assert built.n_lists == 64
+    # oversized lists split with duplicated centroids (skew-bounded cap),
+    # so n_lists can exceed the requested count
+    assert built.n_lists >= 64
+    assert built.centers.shape == (built.n_lists, x.shape[1])
     assert built.size == x.shape[0]
     sizes = np.asarray(built.list_sizes)
     assert sizes.sum() == x.shape[0]
@@ -71,6 +74,22 @@ def test_extend(data):
     _, gt = brute_force.knn(x, q, 10)
     _, idx = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), index, q, 10)
     assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.99
+
+
+def test_extend_n_lists_stable(data):
+    """Repeated extends must not inflate n_lists: split shards are merged
+    back to their parent centroid before each re-pack."""
+    x, _ = data
+    params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=5, add_data_on_build=False)
+    index = ivf_flat.build(params, x)
+    chunk = x.shape[0] // 8
+    for i in range(8):
+        ids = np.arange(i * chunk, (i + 1) * chunk, dtype=np.int32)
+        index = ivf_flat.extend(index, x[i * chunk : (i + 1) * chunk], ids)
+    # bound: the 32 requested lists plus at most the splits one full pack
+    # of the whole dataset can produce at 2x-mean capacity
+    assert index.n_lists <= 2 * 32
+    assert index.size == chunk * 8
 
 
 def test_bitset_prefilter(built, data):

@@ -33,7 +33,10 @@ def built(data):
 
 def test_build_properties(built, data):
     x, _ = data
-    assert built.n_lists == 50
+    # oversized lists split with duplicated centroids (skew-bounded cap),
+    # so n_lists can exceed the requested count
+    assert built.n_lists >= 50
+    assert built.centers.shape == (built.n_lists, x.shape[1])
     assert built.size == x.shape[0]
     assert built.pq_dim == 32
     assert built.pq_len == 2
