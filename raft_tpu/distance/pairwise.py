@@ -76,12 +76,52 @@ def _mm(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.matmul(a, b, precision=_PREC)
 
 
+# 8-bit only: an int32 accumulator holds 255²·d exactly up to d≈33k; wider
+# integer inputs would silently overflow it, so they take the f32 path.
+_INT8_DTYPES = (jnp.int8, jnp.uint8)
+
+
+def _int_gram(xt: jax.Array, y: jax.Array) -> Optional[jax.Array]:
+    """Exact integer Gram x·yᵀ on the MXU's native int8 path when both
+    operands are 8-bit (ref: the reference's int8/uint8 dataset templates,
+    neighbors/detail/ivf_pq_build.cuh:1690 — on TPU int8 matmul is a
+    first-class MXU mode, so low-precision data skips the f32 copy
+    entirely)."""
+    if (
+        xt.dtype in _INT8_DTYPES
+        and y.dtype in _INT8_DTYPES
+        and xt.shape[1] <= 32_000
+    ):
+        return lax.dot_general(
+            xt.astype(jnp.int32) if xt.dtype == jnp.uint8 else xt,
+            (y.astype(jnp.int32) if y.dtype == jnp.uint8 else y).T,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+    return None
+
+
 def _expanded_tile(xt: jax.Array, y: jax.Array, metric: str) -> jax.Array:
     """Gram-term metrics: one matmul + fused epilogue.
 
     (ref: the ‖x‖²+‖y‖²−2x·y decomposition in
     distance/detail/distance_ops/l2_exp.cuh and cosine.cuh.)
     """
+    if metric in ("euclidean", "sqeuclidean", "inner_product", "cosine"):
+        int_ip = _int_gram(xt, y)
+        if int_ip is not None:
+            if metric == "inner_product":
+                return int_ip
+            xx = jnp.sum(
+                xt.astype(jnp.float32) * xt.astype(jnp.float32), axis=1
+            )
+            yy = jnp.sum(y.astype(jnp.float32) * y.astype(jnp.float32), axis=1)
+            if metric == "cosine":
+                nx = jnp.sqrt(xx)
+                ny = jnp.sqrt(yy)
+                return 1.0 - int_ip / jnp.maximum(nx[:, None] * ny[None, :], 1e-30)
+            d2 = jnp.maximum(xx[:, None] + yy[None, :] - 2.0 * int_ip, 0.0)
+            return jnp.sqrt(d2) if metric == "euclidean" else d2
     f32 = xt.astype(jnp.float32)
     yf = y.astype(jnp.float32)
     if metric == "hellinger":

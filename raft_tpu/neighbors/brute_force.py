@@ -130,9 +130,20 @@ def knn(
     else:
         elem = 4 * d * query_tile
     tile_cols = int(min(n, max(512, res.workspace_rows(elem, cap=1 << 14))))
+    # keep the dataset in its input dtype (int8/uint8/bf16/f32 — ref
+    # low-precision dataset templates, ivf_flat_types.hpp:47): tiles are
+    # cast (or int8-MXU dotted) inside distance_matrix_tile, so HBM holds
+    # no fp32 copy of the dataset. Integer queries against an integer
+    # dataset take the exact int-Gram path; mixed cases fall back to f32
+    # queries with per-tile dataset casts.
+    both_int = jnp.issubdtype(dataset.dtype, jnp.integer) and jnp.issubdtype(
+        queries.dtype, jnp.integer
+    )
+    if not both_int and queries.dtype != jnp.float32:
+        queries = queries.astype(jnp.float32)
     vals, idx = _tiled_knn(
-        queries.astype(jnp.float32),
-        dataset.astype(jnp.float32),
+        queries,
+        dataset,
         int(k),
         canonical,
         p,

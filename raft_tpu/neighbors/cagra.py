@@ -229,7 +229,9 @@ def build(
 ) -> Index:
     """(ref: cagra_build.cuh build: build_knn_graph → sort → optimize)"""
     res = ensure(res)
-    dataset = jnp.asarray(dataset, jnp.float32)
+    # keep the dataset in its input dtype (f32/bf16/int8/uint8 — ref CAGRA
+    # dtype templates cagra_types.hpp:142); search casts gathered rows only
+    dataset = jnp.asarray(dataset)
     n, d = dataset.shape
     metric = DISTANCE_TYPES[params.metric]
     if metric not in ("sqeuclidean", "euclidean", "inner_product"):
@@ -289,7 +291,7 @@ def build(
 def from_graph(metric: str, dataset: jax.Array, graph: jax.Array) -> Index:
     """Construct an index from a prebuilt graph (ref: cagra index ctor from
     existing dataset+graph mdspans, cagra_types.hpp:142)."""
-    return Index(metric, jnp.asarray(dataset, jnp.float32), jnp.asarray(graph, jnp.int32))
+    return Index(metric, jnp.asarray(dataset), jnp.asarray(graph, jnp.int32))
 
 
 # --------------------------------------------------------------------------
@@ -308,9 +310,11 @@ def _query_distance(qs: jax.Array, vecs: jax.Array, metric: str) -> jax.Array:
 
 def _gather_rows(dataset, ids):
     """Candidate-row gather: dense take or VPQ decode-on-gather
-    (ref: compute_distance_vpq.cuh decodes codes inside the kernel)."""
+    (ref: compute_distance_vpq.cuh decodes codes inside the kernel).
+    Returns f32 — the cast runs on the gathered tile only, so a
+    low-precision dataset is never copied whole to fp32."""
     if isinstance(dataset, jax.Array):
-        return dataset[jnp.clip(ids, 0, dataset.shape[0] - 1)]
+        return dataset[jnp.clip(ids, 0, dataset.shape[0] - 1)].astype(jnp.float32)
     return dataset.decode(ids)
 
 

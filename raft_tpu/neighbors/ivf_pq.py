@@ -426,29 +426,36 @@ def extend(
     *,
     res: Optional[Resources] = None,
 ) -> Index:
-    """Encode + append rows (ref: extend detail/ivf_pq_build.cuh:1501)."""
+    """Encode + append rows (ref: extend detail/ivf_pq_build.cuh:1501).
+
+    ``new_vectors`` may be any supported dtype (f32/bf16/int8/uint8 — ref
+    ivf_pq_build.cuh:1690 dtype templates); rows are cast to f32 one tile
+    at a time inside the predict+encode loop, so no full-precision copy of
+    the input is ever materialized."""
     res = ensure(res)
-    x = jnp.asarray(new_vectors, jnp.float32)
+    x = jnp.asarray(new_vectors)
     canonical = DISTANCE_TYPES[index.metric]
-    labels = kmeans_balanced.predict(
-        index.centers, x,
-        metric="inner_product" if canonical == "inner_product" else "sqeuclidean",
-        res=res,
-    )
-    # batch the encode to bound the [n, rot_dim]+einsum workspace
+    kb_metric = "inner_product" if canonical == "inner_product" else "sqeuclidean"
+    # tile the predict+encode to bound the [tile, rot_dim]+einsum workspace
     n = x.shape[0]
     tile = max(1, res.workspace_rows(4 * (index.rot_dim * 3 + index.pq_dim * index.pq_n_centers), cap=1 << 18))
-    codes_parts = []
+    codes_parts, label_parts = [], []
     for s in range(0, n, tile):
+        xt = x[s : s + tile].astype(jnp.float32)
+        lt = kmeans_balanced.predict(index.centers, xt, metric=kb_metric, res=res)
         codes_parts.append(
             np.asarray(
                 _encode(
                     index.rotation, index.centers, index.centers_rot, index.codebook,
-                    x[s : s + tile], labels[s : s + tile], index.codebook_kind,
+                    xt, lt, index.codebook_kind,
                 )
             )
         )
+        label_parts.append(np.asarray(lt))
     codes = np.concatenate(codes_parts) if codes_parts else np.zeros((0, index.pq_dim), np.uint8)
+    labels = (
+        np.concatenate(label_parts) if label_parts else np.zeros((0,), np.int32)
+    )
 
     old_n = index.size
     if new_indices is None:

@@ -68,7 +68,10 @@ class Index:
 
 
 def _row_distance(x: jax.Array, cand: jax.Array, metric: str) -> jax.Array:
-    """dist(x[i], cand[i, j]) for [n, d] vs [n, c, d] — batched row-vs-rows."""
+    """dist(x[i], cand[i, j]) for [n, d] vs [n, c, d] — batched row-vs-rows.
+    Casts per gathered tile, so low-precision datasets stream as-is."""
+    x = x.astype(jnp.float32)
+    cand = cand.astype(jnp.float32)
     ip = jnp.einsum("nd,ncd->nc", x, cand, precision=_PREC)
     if metric == "inner_product":
         return -ip
@@ -166,7 +169,8 @@ def build(
     """Build an approximate kNN graph by NN-descent iterations
     (ref: nn_descent.cuh GNND::build)."""
     res = ensure(res)
-    dataset = jnp.asarray(dataset, jnp.float32)
+    # keep the dataset in its input dtype; _row_distance casts per gather
+    dataset = jnp.asarray(dataset)
     n, d = dataset.shape
     metric = DISTANCE_TYPES[params.metric]
     k = min(params.intermediate_graph_degree, n - 1)
@@ -210,7 +214,8 @@ def build_exact(
     graphs this way too (cagra_build.cuh build_knn_graph with ivf_pq is
     approximate; tests use exact ground truth)."""
     res = ensure(res)
-    dataset = jnp.asarray(dataset, jnp.float32)
+    # brute_force.knn handles low-precision dtypes natively (int8 MXU Gram)
+    dataset = jnp.asarray(dataset)
     dists, ids = brute_force.knn(
         dataset, dataset, graph_degree + 1, metric=metric, res=res
     )

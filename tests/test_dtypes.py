@@ -1,0 +1,82 @@
+"""Low-precision dataset paths: int8/uint8/bf16 end-to-end.
+
+The reference templates brute-force/IVF/CAGRA over float/half/int8/uint8
+(ref: neighbors/detail/ivf_pq_build.cuh:1690, ivf_flat_types.hpp:47,
+cagra_types.hpp:142). Here: datasets stay in their input dtype (no fp32
+copy in HBM), integer Gram rides the MXU int8 path, and recall gates hold.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.stats import neighborhood_recall
+
+
+def _int_data(dtype, n=6000, d=64, n_q=100, seed=0):
+    rng = np.random.default_rng(seed)
+    lo, hi = (0, 256) if dtype == np.uint8 else (-128, 128)
+    # clustered so IVF probing is meaningful
+    centers = rng.integers(lo + 40, hi - 40, (40, d))
+    asg = rng.integers(0, 40, n)
+    x = np.clip(centers[asg] + rng.integers(-20, 20, (n, d)), lo, hi - 1).astype(dtype)
+    qasg = rng.integers(0, 40, n_q)
+    q = np.clip(centers[qasg] + rng.integers(-20, 20, (n_q, d)), lo, hi - 1).astype(dtype)
+    return x, q
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+@pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product"])
+def test_brute_force_int_exact(dtype, metric):
+    """Integer kNN must match the f32 result exactly (int32 Gram is exact)."""
+    x, q = _int_data(dtype, n=2000, d=32, n_q=50)
+    v_int, i_int = brute_force.knn(x, q, 10, metric=metric)
+    v_f32, i_f32 = brute_force.knn(
+        x.astype(np.float32), q.astype(np.float32), 10, metric=metric
+    )
+    np.testing.assert_allclose(np.asarray(v_int), np.asarray(v_f32), rtol=1e-5)
+    assert float(neighborhood_recall(np.asarray(i_int), np.asarray(i_f32))) == 1.0
+
+
+def test_brute_force_bf16_dataset():
+    x, q = _int_data(np.uint8, n=2000, d=32, n_q=50)
+    xb = jnp.asarray(x, jnp.bfloat16)
+    v, i = brute_force.knn(xb, q.astype(np.float32), 10)
+    _, gt = brute_force.knn(x.astype(np.float32), q.astype(np.float32), 10)
+    # bf16 rounding can flip near-ties; recall stays near-exact
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(gt))) >= 0.99
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+def test_ivf_flat_int_dataset(dtype):
+    x, q = _int_data(dtype)
+    params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=5)
+    index = ivf_flat.build(params, x)
+    assert index.list_data.dtype == jnp.asarray(x).dtype  # stored as input dtype
+    _, gt = brute_force.knn(x, q, 10)
+    _, idx = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), index, q, 10)
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.95
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+def test_ivf_pq_int_dataset(dtype):
+    x, q = _int_data(dtype)
+    params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=5)
+    index = ivf_pq.build(params, x)
+    _, gt = brute_force.knn(x, q, 10)
+    _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 40)
+    _, idx = refine(x, q, cand, 10)
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.9
+
+
+@pytest.mark.parametrize("dtype", [np.uint8])
+def test_cagra_int_dataset(dtype):
+    x, q = _int_data(dtype, n=4000)
+    params = cagra.IndexParams(graph_degree=32, intermediate_graph_degree=48)
+    index = cagra.build(params, x)
+    assert index.dataset.dtype == jnp.asarray(x).dtype
+    _, gt = brute_force.knn(x, q, 10)
+    _, idx = cagra.search(cagra.SearchParams(itopk_size=64), index, q, 10)
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.9
