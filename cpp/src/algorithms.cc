@@ -1,0 +1,187 @@
+// Host-side algorithm entry points for the stable C ABI — the raft_runtime
+// role (ref: cpp/include/raft_runtime/neighbors/*.hpp): non-templated
+// symbols Python binds with ctypes. On TPU the device path is XLA, so the
+// native algorithm surface covers the *host* halves the reference also runs
+// on CPU: exact candidate refinement (ref: neighbors/detail/
+// refine_host-inl.hpp, an OpenMP loop over queries) and IVF list
+// packing/splitting (ref: neighbors/ivf_flat_codepacker.hpp + the list
+// layout logic of detail/ivf_flat_build.cuh:88-154).
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "raft_tpu/core/error.hpp"
+
+namespace {
+thread_local std::string g_alg_error;
+
+int fail_alg(const std::exception& e) {
+  g_alg_error = e.what();
+  return 1;
+}
+
+// metric codes shared with raft_tpu/core/native.py
+enum class metric_code : int {
+  sqeuclidean = 0,
+  euclidean = 1,
+  inner_product = 2,
+  cosine = 3,
+};
+
+void refine_rows(const float* dataset, std::int64_t n, std::int64_t d,
+                 const float* queries, const std::int32_t* candidates,
+                 std::int64_t k_cand, std::int64_t k, metric_code metric,
+                 float* out_d, std::int32_t* out_i, std::int64_t q_begin,
+                 std::int64_t q_end) {
+  std::vector<std::pair<float, std::int32_t>> scored(k_cand);
+  for (std::int64_t q = q_begin; q < q_end; ++q) {
+    const float* qv = queries + q * d;
+    float q2 = 0.f;
+    for (std::int64_t j = 0; j < d; ++j) q2 += qv[j] * qv[j];
+    const float qnorm = std::max(std::sqrt(q2), 1e-12f);
+    for (std::int64_t c = 0; c < k_cand; ++c) {
+      std::int32_t id = candidates[q * k_cand + c];
+      if (id < 0 || id >= n) {
+        scored[c] = {std::numeric_limits<float>::infinity(), -1};
+        continue;
+      }
+      const float* rv = dataset + static_cast<std::int64_t>(id) * d;
+      float ip = 0.f, rn2 = 0.f;
+      for (std::int64_t j = 0; j < d; ++j) {
+        ip += qv[j] * rv[j];
+        rn2 += rv[j] * rv[j];
+      }
+      float dist;
+      switch (metric) {
+        case metric_code::inner_product:
+          dist = -ip;  // select smallest
+          break;
+        case metric_code::cosine:
+          dist = 1.f - ip / (qnorm * std::max(std::sqrt(rn2), 1e-12f));
+          break;
+        default: {  // (sq)euclidean
+          dist = std::max(q2 + rn2 - 2.f * ip, 0.f);
+          if (metric == metric_code::euclidean) dist = std::sqrt(dist);
+        }
+      }
+      scored[c] = {dist, id};
+    }
+    std::partial_sort(scored.begin(), scored.begin() + k, scored.end());
+    for (std::int64_t j = 0; j < k; ++j) {
+      float v = scored[j].first;
+      // IP negates unconditionally so padding (+inf in selection space)
+      // comes back as -inf — worst similarity, matching the jax path
+      out_d[q * k + j] = metric == metric_code::inner_product ? -v : v;
+      out_i[q * k + j] = scored[j].second;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* rt_alg_last_error() { return g_alg_error.c_str(); }
+
+// Exact re-rank of ANN candidates on the host, threaded over queries
+// (ref: neighbors/detail/refine_host-inl.hpp; exposed like
+// raft_runtime/neighbors/refine.hpp).
+int rt_refine_host(const float* dataset, int64_t n, int64_t d,
+                   const float* queries, int64_t n_q,
+                   const int32_t* candidates, int64_t k_cand, int64_t k,
+                   int metric, float* out_d, int32_t* out_i, int n_threads) {
+  try {
+    RAFT_TPU_EXPECTS(k <= k_cand, "k exceeds candidate count");
+    if (n_threads <= 0)
+      n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    n_threads = std::max(1, std::min<int>(n_threads, 64));
+    auto m = static_cast<metric_code>(metric);
+    if (n_q < 64 || n_threads == 1) {
+      refine_rows(dataset, n, d, queries, candidates, k_cand, k, m, out_d,
+                  out_i, 0, n_q);
+      return 0;
+    }
+    std::vector<std::thread> ts;
+    std::int64_t chunk = (n_q + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      std::int64_t b = t * chunk, e = std::min<std::int64_t>(n_q, b + chunk);
+      if (b >= e) break;
+      ts.emplace_back(refine_rows, dataset, n, d, queries, candidates, k_cand,
+                      k, m, out_d, out_i, b, e);
+    }
+    for (auto& t : ts) t.join();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
+// IVF list layout: assign each row a (list, slot), splitting lists that
+// exceed max_cap into shards that duplicate their parent centroid
+// (center_map). The slot assignment is deterministic: rows keep their
+// input order within a list (stable counting sort).
+// Outputs:
+//   slot_out    [n]   — slot within the assigned (possibly shard) list
+//   list_out    [n]   — final list id per row
+//   center_map  [max_out_lists] — parent list per final list
+//   n_lists_out, cap_out — final list count and padded capacity (multiple of 8)
+int rt_pack_list_layout(const int64_t* labels, int64_t n, int64_t n_lists,
+                        int64_t max_cap, int32_t* slot_out, int64_t* list_out,
+                        int64_t* center_map, int64_t max_out_lists,
+                        int64_t* n_lists_out, int64_t* cap_out) {
+  try {
+    RAFT_TPU_EXPECTS(max_cap > 0, "max_cap must be positive");
+    std::vector<std::int64_t> sizes(n_lists, 0);
+    for (std::int64_t i = 0; i < n; ++i) {
+      RAFT_TPU_EXPECTS(labels[i] >= 0 && labels[i] < n_lists,
+                       "label out of range");
+      ++sizes[labels[i]];
+    }
+    // shard table: parent list l gets ceil(size/max_cap) shards; shard 0
+    // keeps the original id, the rest append after n_lists
+    std::vector<std::int64_t> first_extra(n_lists, -1);
+    std::int64_t next_id = n_lists;
+    for (std::int64_t l = 0; l < n_lists; ++l) {
+      std::int64_t parts = sizes[l] > 0 ? (sizes[l] + max_cap - 1) / max_cap : 1;
+      if (parts > 1) {
+        first_extra[l] = next_id;
+        next_id += parts - 1;
+      }
+    }
+    RAFT_TPU_EXPECTS(next_id <= max_out_lists,
+                     "center_map buffer too small");
+    for (std::int64_t l = 0; l < n_lists; ++l) center_map[l] = l;
+    for (std::int64_t l = 0; l < n_lists; ++l) {
+      if (first_extra[l] < 0) continue;
+      std::int64_t parts = (sizes[l] + max_cap - 1) / max_cap;
+      for (std::int64_t p = 1; p < parts; ++p)
+        center_map[first_extra[l] + p - 1] = l;
+    }
+    // stable slot assignment: running fill count per parent; row i of its
+    // parent goes to shard fill/max_cap, slot fill%max_cap
+    std::vector<std::int64_t> fill(n_lists, 0);
+    std::int64_t max_size = 0;
+    for (std::int64_t i = 0; i < n; ++i) {
+      std::int64_t l = labels[i];
+      std::int64_t f = fill[l]++;
+      std::int64_t shard = f / max_cap;
+      list_out[i] = shard == 0 ? l : first_extra[l] + shard - 1;
+      slot_out[i] = static_cast<std::int32_t>(f % max_cap);
+    }
+    for (std::int64_t l = 0; l < n_lists; ++l)
+      max_size = std::max(max_size, std::min(sizes[l], max_cap));
+    std::int64_t cap = std::max<std::int64_t>(8, (max_size + 7) / 8 * 8);
+    *n_lists_out = next_id;
+    *cap_out = cap;
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
+}  // extern "C"

@@ -37,15 +37,25 @@ _DTYPES_INV = {v: k for k, v in _DTYPES.items()}
 LOG_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p)
 
 
-def _build() -> Optional[str]:
+def _build(force: bool = False) -> Optional[str]:
+    import glob as _glob
+
     cpp = os.path.abspath(_CPP_DIR)
     so = os.path.join(cpp, "libraft_tpu_core.so")
-    srcs = [os.path.join(cpp, "src", s) for s in ("serialize.cc", "c_api.cc")]
-    if os.path.exists(so) and all(
-        os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs
+    srcs = _glob.glob(os.path.join(cpp, "src", "*.cc"))
+    if (
+        not force
+        and os.path.exists(so)
+        and srcs
+        and all(os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs)
     ):
         return so
     try:
+        if force:
+            subprocess.run(
+                ["make", "-C", cpp, "clean"], check=True,
+                capture_output=True, timeout=60,
+            )
         subprocess.run(
             ["make", "-C", cpp, "-j4"], check=True,
             capture_output=True, timeout=120,
@@ -65,6 +75,17 @@ def _load():
             _LIB = False
             return _LIB
         lib = ctypes.CDLL(so)
+        if not hasattr(lib, "rt_alg_last_error"):
+            # stale prebuilt library from before the algorithm entry points
+            # existed — force a clean rebuild, else degrade gracefully
+            so = _build(force=True)
+            if so is None:
+                _LIB = False
+                return _LIB
+            lib = ctypes.CDLL(so)
+            if not hasattr(lib, "rt_alg_last_error"):
+                _LIB = False
+                return _LIB
         lib.rt_last_error.restype = ctypes.c_char_p
         lib.rt_resources_create.restype = ctypes.c_void_p
         lib.rt_resources_create.argtypes = [ctypes.c_size_t]
@@ -98,6 +119,22 @@ def _load():
         lib.rt_interruptible_cancelled.argtypes = [ctypes.c_void_p]
         lib.rt_interruptible_check.restype = ctypes.c_int
         lib.rt_interruptible_check.argtypes = [ctypes.c_void_p]
+        # algorithm entry points (ref: raft_runtime/neighbors/*.hpp role)
+        lib.rt_alg_last_error.restype = ctypes.c_char_p
+        lib.rt_refine_host.restype = ctypes.c_int
+        lib.rt_refine_host.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,  # dataset
+            ctypes.c_void_p, ctypes.c_int64,                   # queries
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,   # candidates, k
+            ctypes.c_int,                                      # metric
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,    # outs, threads
+        ]
+        lib.rt_pack_list_layout.restype = ctypes.c_int
+        lib.rt_pack_list_layout.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
         _LIB = lib
         return _LIB
 
@@ -199,6 +236,66 @@ def log_set_callback(fn) -> None:
     cb = LOG_CALLBACK(lambda lvl, msg, _u: fn(lvl, msg.decode()))
     _cb_keepalive.append(cb)
     _lib().rt_log_set_callback(cb, None)
+
+
+_METRIC_CODES = {"sqeuclidean": 0, "euclidean": 1, "inner_product": 2, "cosine": 3}
+
+
+def refine_host(
+    dataset: np.ndarray,
+    queries: np.ndarray,
+    candidates: np.ndarray,
+    k: int,
+    metric: str = "sqeuclidean",
+    n_threads: int = 0,
+):
+    """Native exact candidate re-rank, threaded over queries
+    (ref: neighbors/detail/refine_host-inl.hpp via the raft_runtime-style
+    C ABI). Returns (distances [q, k] f32, indices [q, k] i32)."""
+    if metric not in _METRIC_CODES:
+        raise ValueError(f"unsupported native refine metric {metric!r}")
+    dataset = np.ascontiguousarray(dataset, np.float32)
+    queries = np.ascontiguousarray(queries, np.float32)
+    candidates = np.ascontiguousarray(candidates, np.int32)
+    n_q, k_cand = candidates.shape
+    out_d = np.empty((n_q, k), np.float32)
+    out_i = np.empty((n_q, k), np.int32)
+    code = _lib().rt_refine_host(
+        dataset.ctypes.data_as(ctypes.c_void_p), dataset.shape[0], dataset.shape[1],
+        queries.ctypes.data_as(ctypes.c_void_p), n_q,
+        candidates.ctypes.data_as(ctypes.c_void_p), k_cand, k,
+        _METRIC_CODES[metric],
+        out_d.ctypes.data_as(ctypes.c_void_p),
+        out_i.ctypes.data_as(ctypes.c_void_p),
+        n_threads,
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return out_d, out_i
+
+
+def pack_list_layout(labels: np.ndarray, n_lists: int, max_cap: int):
+    """Native IVF list layout: (slot [n] i32, list [n] i64,
+    center_map [n_lists'] i64, cap) with oversized lists split into shards
+    (ref: the list layout of ivf_flat_build.cuh:88-154 + codepacker role)."""
+    labels = np.ascontiguousarray(labels, np.int64)
+    n = labels.shape[0]
+    max_out = n_lists + (n // max(max_cap, 1)) + 1
+    slot = np.empty(n, np.int32)
+    lst = np.empty(n, np.int64)
+    cmap = np.empty(max_out, np.int64)
+    n_out = ctypes.c_int64()
+    cap = ctypes.c_int64()
+    code = _lib().rt_pack_list_layout(
+        labels.ctypes.data_as(ctypes.c_void_p), n, n_lists, max_cap,
+        slot.ctypes.data_as(ctypes.c_void_p),
+        lst.ctypes.data_as(ctypes.c_void_p),
+        cmap.ctypes.data_as(ctypes.c_void_p), max_out,
+        ctypes.byref(n_out), ctypes.byref(cap),
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return slot, lst, cmap[: n_out.value].copy(), int(cap.value)
 
 
 class InterruptibleToken:

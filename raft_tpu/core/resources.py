@@ -110,6 +110,28 @@ class Resources:
             self._seed = seed
             self._key_counter = 0
 
+    # -- native backing (ref: raft::resources is the native container; here
+    #    the C++ handle backs the Python one so the workspace arena and any
+    #    future native state share one registry) ---------------------------
+    @property
+    def native(self):
+        """The C++ ``resources`` handle backing this object (lazily built;
+        None when no toolchain is available). Created with the same
+        workspace byte limit this object budgets tiles against; the two
+        arenas account independently, so native scratch is bounded by the
+        same figure, not pooled with device workspace."""
+        key = "native_resources"
+        if not self.has_resource_factory(key):
+            from raft_tpu.core import native as _native
+
+            def _make(res_):
+                if not _native.available():
+                    return None
+                return _native.NativeResources(res_.workspace_limit_bytes)
+
+            self.add_resource_factory(key, _make)
+        return self.get_resource(key)
+
     # -- comms (ref: core/resource/comms.hpp — COMMUNICATOR resource) ------
     @property
     def comms(self):

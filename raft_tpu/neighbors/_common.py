@@ -94,8 +94,24 @@ def pack_padded_lists(
     split_oversized_lists) so cap ≤ round_up(max_cap, 8) regardless of
     cluster skew; center_map tells the caller how to expand its centroid
     rows (identity when nothing split)."""
+    from raft_tpu.core import native
+
     n = payload.shape[0]
     labels = np.asarray(labels, np.int64)
+    if max_cap is not None and n and native.available():
+        # native layout pass (threads/split logic in C++; the payload
+        # scatter — pure memcpy — stays in numpy fancy indexing)
+        slot, lst, center_map, cap = native.pack_list_layout(
+            labels, n_lists, max_cap
+        )
+        n_lists = len(center_map)
+        list_payload = np.zeros((n_lists, cap) + payload.shape[1:], payload.dtype)
+        list_index = np.full((n_lists, cap), -1, np.int32)
+        list_payload[lst, slot] = payload
+        list_index[lst, slot] = ids
+        sizes = np.bincount(lst, minlength=n_lists)
+        return list_payload, list_index, sizes.astype(np.int32), center_map
+
     if max_cap is not None:
         labels, center_map = split_oversized_lists(labels, n_lists, max_cap)
         n_lists = len(center_map)

@@ -85,7 +85,18 @@ def refine(
 
 def _refine_host(dataset, queries, candidates, k, metric):
     """CPU refine (ref: detail/refine_host-inl.hpp — OpenMP loop over
-    queries; here vectorized numpy, released-GIL BLAS)."""
+    queries). Uses the native threaded C++ entry point when the toolchain
+    built it (raft_runtime parity); falls back to vectorized numpy."""
+    from raft_tpu.core import native
+
+    if (
+        metric in native._METRIC_CODES
+        and dataset.dtype == np.float32
+        and dataset.flags.c_contiguous  # native path must not copy the dataset
+        and native.available()
+    ):
+        v, i = native.refine_host(dataset, queries, candidates, k, metric)
+        return jnp.asarray(v), jnp.asarray(i)
     safe = np.clip(candidates, 0, dataset.shape[0] - 1)
     cand = dataset[safe].astype(np.float32)
     qf = queries.astype(np.float32)

@@ -79,3 +79,47 @@ def test_interruptible():
     # flag cleared by the failed check (reference behavior)
     assert not tok.cancelled
     tok.check()
+
+
+def test_refine_host_matches_numpy(rng):
+    """Native threaded refine (raft_runtime-style entry point) vs the jax
+    device refine."""
+    from raft_tpu.neighbors.refine import refine
+
+    x = rng.random((500, 24)).astype(np.float32)
+    q = rng.random((40, 24)).astype(np.float32)
+    cand = rng.integers(-1, 500, (40, 30)).astype(np.int32)
+    for metric in ("sqeuclidean", "euclidean", "inner_product", "cosine"):
+        vd, idd = refine(x, q, cand, 5, metric=metric, host=False)
+        vh, idh = native.refine_host(x, q, cand, 5, metric)
+        np.testing.assert_allclose(np.asarray(vd), vh, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(idd), idh)
+
+
+def test_pack_list_layout_split(rng):
+    """Native list layout: shards appear for oversized lists, slots dense."""
+    labels = np.concatenate([np.zeros(100, np.int64), np.ones(10, np.int64)])
+    slot, lst, cmap, cap = native.pack_list_layout(labels, 2, 32)
+    assert cap == 32
+    # list 0 (100 rows, max_cap 32) → 4 shards: ids {0, 2, 3, 4}
+    assert len(cmap) == 5
+    assert list(cmap) == [0, 1, 0, 0, 0]
+    counts = np.bincount(lst, minlength=5)
+    assert counts.tolist() == [32, 10, 32, 32, 4]
+    # slots dense per shard
+    for l in range(5):
+        s = np.sort(slot[lst == l])
+        np.testing.assert_array_equal(s, np.arange(len(s)))
+
+
+def test_resources_native_backing():
+    from raft_tpu.core.resources import Resources
+
+    res = Resources(workspace_limit_bytes=1 << 20)
+    nat = res.native
+    if nat is None:
+        pytest.skip("no native toolchain")
+    p = nat.workspace_alloc(1024)
+    assert nat.workspace_used >= 1024
+    nat.workspace_free(p)
+    assert res.native is nat  # cached on the registry
