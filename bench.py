@@ -139,13 +139,14 @@ def main() -> None:
     build_s = time.perf_counter() - t0
 
     # --- find the operating point: smallest n_probes with recall >= 0.95
-    # (candidates k*4 then exact refine, the reference's standard recipe;
-    # search + refine fused into one jitted program so dispatch overhead is
-    # paid once per batch)
+    # (candidates k*4 then exact refine, the reference's standard recipe).
+    # NOT wrapped in an outer jit: that would close over the index arrays
+    # and bake them in as XLA constants (compile-time blowup); search and
+    # refine are each jitted internally, and two dispatches amortize fine
+    # over a 10k-query batch.
     def make_search(n_probes):
         sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
 
-        @jax.jit
         def fn(q):
             cd, ci = ivf_pq.search(sp, index, q, k * 4, res=res)
             return refine_fn(dataset, q, ci, k, metric="sqeuclidean", res=res)

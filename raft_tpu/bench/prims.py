@@ -1,0 +1,138 @@
+"""Primitive-level microbenchmarks — bench/prims parity.
+
+Reference: ``cpp/bench/prims/`` runs Google-Benchmark timings per primitive
+(matrix/select_k, distance, linalg, cluster, random). Here: a table of
+wall-clock timings for the hot primitives, runnable on any backend:
+
+    python -m raft_tpu.bench.prims [--out results.json] [--filter select_k]
+
+Timings amortize dispatch latency over inner iterations (the axon tunnel
+costs ~75 ms per dispatch, so single-call timing would be meaningless —
+measured in round 2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+# platform override must land before any backend init (same contract as
+# raft_tpu.bench.__main__)
+if os.environ.get("RAFT_TPU_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])
+
+
+def _timeit(fn: Callable, args, warmup: int = 2, iters: int = 5) -> float:
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _cases() -> List[Dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.distance.fused_nn import fused_l2_nn_argmin
+    from raft_tpu.distance.pairwise import pairwise_distance
+    from raft_tpu.ops.matrix import select_k
+
+    rng = np.random.default_rng(0)
+    cases = []
+
+    # NB: operands are passed as call arguments, never closed over — a
+    # closed-over array becomes an XLA constant and the whole benchmark gets
+    # constant-folded at compile time.
+
+    # select_k (ref: bench/prims/matrix/select_k.cu shapes)
+    for rows, cols, k in [(1024, 16384, 64), (128, 131072, 256), (4096, 2048, 10)]:
+        x = jnp.asarray(rng.standard_normal((rows, cols)).astype(np.float32))
+        fn = jax.jit(functools.partial(select_k, k=k, select_min=True))
+        cases.append(
+            {
+                "name": f"select_k/{rows}x{cols}/k{k}",
+                "fn": fn,
+                "args": (x,),
+                "bytes": rows * cols * 4,
+                "flops": 0,
+            }
+        )
+
+    # pairwise distance (ref: bench/prims/distance/)
+    for m, n, d, metric in [(2048, 2048, 128, "sqeuclidean"), (1024, 1024, 512, "l1")]:
+        a = jnp.asarray(rng.standard_normal((m, d)).astype(np.float32))
+        b = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+        fn = jax.jit(functools.partial(pairwise_distance, metric=metric))
+        cases.append(
+            {
+                "name": f"pairwise/{metric}/{m}x{n}x{d}",
+                "fn": fn,
+                "args": (a, b),
+                "bytes": (m + n) * d * 4 + m * n * 4,
+                "flops": 2 * m * n * d,
+            }
+        )
+
+    # fused L2 argmin — the kmeans inner loop (ref: bench/prims/distance/fused_l2_nn.cu)
+    m, n, d = 8192, 1024, 128
+    a = jnp.asarray(rng.standard_normal((m, d)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    cases.append(
+        {
+            "name": f"fused_l2_nn/{m}x{n}x{d}",
+            "fn": jax.jit(fused_l2_nn_argmin),
+            "args": (a, b),
+            "bytes": (m + n) * d * 4,
+            "flops": 2 * m * n * d,
+        }
+    )
+    return cases
+
+
+def run(filter_: str = "", out_path: str = "") -> List[Dict]:
+    import jax
+
+    results = []
+    for case in _cases():
+        if filter_ and filter_ not in case["name"]:
+            continue
+        s = _timeit(case["fn"], case["args"])
+        row = {
+            "name": case["name"],
+            "seconds": round(s, 6),
+            "gbps": round(case["bytes"] / s / 1e9, 2),
+            "gflops": round(case["flops"] / s / 1e9, 2) if case["flops"] else None,
+            "platform": jax.devices()[0].platform,
+        }
+        results.append(row)
+        print(json.dumps(row))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--filter", default="", help="substring filter on case names")
+    ap.add_argument("--out", default="", help="write JSON results here")
+    args = ap.parse_args()
+    run(args.filter, args.out)
+
+
+if __name__ == "__main__":
+    main()

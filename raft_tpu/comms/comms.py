@@ -137,11 +137,17 @@ class Comms:
 
     # -- host-side ---------------------------------------------------------
     def sync_stream(self, *arrays) -> None:
+        """Blocking sync; a cancellation point like the reference's
+        comms-aware interruptible::synchronize."""
+        from raft_tpu.core import interruptible as _intr
+
+        _intr.check()
         if arrays:
             jax.block_until_ready(arrays)
         else:
             # real fence: round-trip a tiny transfer so all queued work drains
             jax.block_until_ready(jax.device_put(np.zeros(())))
+        _intr.check()
 
 
 def local_comms(n_devices: Optional[int] = None) -> Comms:

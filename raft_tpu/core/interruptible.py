@@ -1,0 +1,72 @@
+"""Cooperative cross-thread cancellation — interruptible parity.
+
+Reference: ``core/interruptible.hpp:41-96`` — every blocking stream sync
+checks a per-thread cancellation token that another CPU thread can set;
+Python surface in pylibraft ``common/interruptible.pyx``.
+
+TPU shape: JAX's ``block_until_ready`` cannot be interrupted mid-wait, so
+the cancellation points are the sync entries themselves: every
+``Resources.sync`` / ``Comms.sync_stream`` calls ``check()`` before and
+after blocking, raising ``InterruptedError`` if this thread's token was
+cancelled. Tokens are native (C++ registry) when the toolchain built the
+core, with a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class _PyToken:
+    def __init__(self):
+        self._flag = threading.Event()
+
+    def cancel(self) -> None:
+        self._flag.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._flag.is_set()
+
+    def check(self) -> None:
+        # reference semantics: a failed check clears the flag
+        if self._flag.is_set():
+            self._flag.clear()
+            raise InterruptedError("interruptible: cancelled")
+
+
+_tokens: Dict[int, object] = {}
+_lock = threading.Lock()
+
+
+def get_token(thread_id: Optional[int] = None):
+    """This (or another) thread's cancellation token
+    (ref: interruptible::get_token)."""
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    with _lock:
+        tok = _tokens.get(tid)
+        if tok is None:
+            from raft_tpu.core import native
+
+            if thread_id is None and native.available():
+                tok = native.InterruptibleToken()
+            else:
+                tok = _PyToken()
+            _tokens[tid] = tok
+        return tok
+
+
+def cancel(thread_id: int) -> None:
+    """Cancel another thread's next sync (ref: interruptible::cancel)."""
+    get_token(thread_id).cancel()
+
+
+def check() -> None:
+    """Raise InterruptedError if this thread was cancelled
+    (ref: interruptible::yield_()). No-op when never cancelled."""
+    tid = threading.get_ident()
+    with _lock:
+        tok = _tokens.get(tid)
+    if tok is not None:
+        tok.check()

@@ -1,0 +1,73 @@
+"""device_resources_manager — process-wide pooled per-device Resources.
+
+Reference: ``core/device_resources_manager.hpp:34-577`` — a singleton that
+hands multithreaded services a pooled ``device_resources`` per GPU with
+configured stream pools and memory limits. TPU shape: one process drives
+all local devices, so the pool maps device ordinal → a cached ``Resources``
+bound to that device, with settable defaults applied before first use.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+from raft_tpu.core.resources import Resources
+
+_lock = threading.Lock()
+_pool: Dict[int, Resources] = {}
+_defaults = {"workspace_limit_bytes": 256 * 1024 * 1024, "seed": 0}
+_frozen = False
+
+
+def set_workspace_limit(limit_bytes: int) -> None:
+    """Configure the workspace budget for future pooled handles
+    (ref: device_resources_manager set_mem_pool/limit setters — like the
+    reference, settings only apply before a device's handle is created)."""
+    global _frozen
+    with _lock:
+        if _frozen:
+            raise RuntimeError(
+                "device_resources_manager settings are frozen after first use"
+            )
+        _defaults["workspace_limit_bytes"] = int(limit_bytes)
+
+
+def set_seed(seed: int) -> None:
+    global _frozen
+    with _lock:
+        if _frozen:
+            raise RuntimeError(
+                "device_resources_manager settings are frozen after first use"
+            )
+        _defaults["seed"] = int(seed)
+
+
+def get_device_resources(device_id: int = 0) -> Resources:
+    """Pooled Resources for one local device (ref:
+    device_resources_manager::get_device_resources)."""
+    global _frozen
+    with _lock:
+        _frozen = True
+        if device_id not in _pool:
+            devs = jax.local_devices()
+            if not 0 <= device_id < len(devs):
+                raise ValueError(
+                    f"device_id {device_id} out of range ({len(devs)} local devices)"
+                )
+            _pool[device_id] = Resources(
+                device=devs[device_id],
+                seed=_defaults["seed"] + device_id,
+                workspace_limit_bytes=_defaults["workspace_limit_bytes"],
+            )
+        return _pool[device_id]
+
+
+def reset() -> None:
+    """Drop pooled handles and unfreeze settings (tests)."""
+    global _frozen
+    with _lock:
+        _pool.clear()
+        _frozen = False

@@ -77,15 +77,12 @@ def _load():
         lib = ctypes.CDLL(so)
         if not hasattr(lib, "rt_alg_last_error"):
             # stale prebuilt library from before the algorithm entry points
-            # existed — force a clean rebuild, else degrade gracefully
-            so = _build(force=True)
-            if so is None:
-                _LIB = False
-                return _LIB
-            lib = ctypes.CDLL(so)
-            if not hasattr(lib, "rt_alg_last_error"):
-                _LIB = False
-                return _LIB
+            # existed. Rebuild for the *next* process (re-CDLL'ing the same
+            # path in this one would hit the loader's pathname cache and
+            # return the old mapping) and degrade gracefully now.
+            _build(force=True)
+            _LIB = False
+            return _LIB
         lib.rt_last_error.restype = ctypes.c_char_p
         lib.rt_resources_create.restype = ctypes.c_void_p
         lib.rt_resources_create.argtypes = [ctypes.c_size_t]
@@ -257,6 +254,16 @@ def refine_host(
     dataset = np.ascontiguousarray(dataset, np.float32)
     queries = np.ascontiguousarray(queries, np.float32)
     candidates = np.ascontiguousarray(candidates, np.int32)
+    if dataset.ndim != 2 or queries.ndim != 2 or candidates.ndim != 2:
+        raise ValueError("dataset, queries and candidates must be 2-D")
+    if queries.shape[1] != dataset.shape[1]:
+        raise ValueError(
+            f"queries dim {queries.shape[1]} != dataset dim {dataset.shape[1]}"
+        )
+    if candidates.shape[0] != queries.shape[0]:
+        raise ValueError(
+            f"candidates rows {candidates.shape[0]} != query count {queries.shape[0]}"
+        )
     n_q, k_cand = candidates.shape
     out_d = np.empty((n_q, k), np.float32)
     out_i = np.empty((n_q, k), np.int32)

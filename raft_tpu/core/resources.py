@@ -147,13 +147,20 @@ class Resources:
         """Block until given arrays (or all dispatched work) are ready.
 
         The analog of ``resource::sync_stream`` — JAX dispatch is async like
-        CUDA streams; call this where the reference synchronizes.
+        CUDA streams; call this where the reference synchronizes. Sync is a
+        cancellation point: another thread can abort it via
+        ``core.interruptible.cancel`` (ref: interruptible::synchronize,
+        core/interruptible.hpp:73).
         """
+        from raft_tpu.core import interruptible as _intr
+
+        _intr.check()
         if arrays:
             jax.block_until_ready(arrays)
         else:
             # effectively a fence: tiny transfer round-trip on this device
             jax.block_until_ready(jax.device_put(np.zeros(()), self.device))
+        _intr.check()
 
     # -- workspace sizing ---------------------------------------------------
     def workspace_rows(self, row_bytes: int, cap: int = 1 << 16) -> int:

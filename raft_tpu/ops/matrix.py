@@ -168,3 +168,66 @@ def linewise_op(m: jax.Array, vec: jax.Array, op, *, along_rows: bool) -> jax.Ar
     if along_rows:
         return op(m, vec[None, :])
     return op(m, vec[:, None])
+
+
+# ---- matrix misc ops (per-function reference cites below) ----------------
+
+
+def threshold(m: jax.Array, value, *, below: bool = True, fill=0.0) -> jax.Array:
+    """Zero (or ``fill``) entries on one side of a threshold
+    (ref: matrix/threshold.cuh zero_small_values)."""
+    mask = m < value if below else m > value
+    return jnp.where(mask, jnp.asarray(fill, m.dtype), m)
+
+
+def ratio(m: jax.Array) -> jax.Array:
+    """Each element divided by the total sum (ref: matrix/ratio.cuh)."""
+    total = jnp.sum(m)
+    return m / jnp.where(total == 0, jnp.ones_like(total), total)
+
+
+def reciprocal(m: jax.Array, *, scalar=1.0, setzero: bool = False, thres: float = 1e-15) -> jax.Array:
+    """scalar / m with optional zeroing of tiny denominators
+    (ref: matrix/reciprocal.cuh)."""
+    out = jnp.asarray(scalar, m.dtype) / m
+    if setzero:
+        out = jnp.where(jnp.abs(m) <= thres, jnp.zeros_like(out), out)
+    return out
+
+
+def sign_flip(m: jax.Array) -> jax.Array:
+    """Flip each column's sign so its max-|value| element is positive —
+    deterministic eigenvector orientation (ref: matrix/sign_flip.cuh,
+    linalg/detail/sign_flip as used by spectral/PCA paths)."""
+    idx = jnp.argmax(jnp.abs(m), axis=0)
+    signs = jnp.sign(m[idx, jnp.arange(m.shape[1])])
+    signs = jnp.where(signs == 0, jnp.ones_like(signs), signs)
+    return m * signs[None, :]
+
+
+def triangular(m: jax.Array, *, upper: bool = True, k: int = 0) -> jax.Array:
+    """Upper/lower triangular copy (ref: matrix/triangular.cuh)."""
+    return jnp.triu(m, k) if upper else jnp.tril(m, k)
+
+
+def eye(n: int, m: Optional[int] = None, dtype=jnp.float32) -> jax.Array:
+    """Identity / rectangular eye (ref: matrix/init.cuh set_diagonal family)."""
+    return jnp.eye(n, m, dtype=dtype)
+
+
+def diagonal(m: jax.Array) -> jax.Array:
+    """Main diagonal view-copy (ref: matrix/diagonal.cuh)."""
+    return jnp.diagonal(m)
+
+
+def set_diagonal(m: jax.Array, value) -> jax.Array:
+    """Return a copy with the main diagonal set (ref: matrix/diagonal.cuh
+    set_diagonal)."""
+    n = min(m.shape[0], m.shape[1])
+    idx = jnp.arange(n)
+    return m.at[idx, idx].set(value)
+
+
+def reverse(m: jax.Array, *, along_rows: bool = False) -> jax.Array:
+    """Reverse row order (or each row) (ref: matrix/reverse.cuh)."""
+    return m[:, ::-1] if along_rows else m[::-1]

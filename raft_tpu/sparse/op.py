@@ -124,3 +124,39 @@ def row_op(csr: CSR, fn) -> CSR:
     rows = csr.row_ids()
     data = jnp.where(csr.valid, fn(rows, csr.data), 0)
     return CSR(csr.indptr, csr.indices, data, csr.shape, csr.nnz)
+
+
+def select_k(csr: CSR, k: int, *, select_min: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Per-row top-k over a CSR matrix's stored values
+    (ref: sparse/matrix/select_k.cuh — batched select over sparse rows).
+
+    Returns (values [n_rows, k], col_ids [n_rows, k]); rows with fewer than
+    k stored entries pad with ±inf / -1. Static-shape formulation: two
+    stable sorts over the slot axis (value, then row) give per-row ranks,
+    then one scatter — no per-row dynamic loops.
+    """
+    n_rows = csr.shape[0]
+    rows = csr.row_ids()                       # padding slots → n_rows
+    vals = csr.data.astype(jnp.float32)
+    worst = jnp.inf if select_min else -jnp.inf
+    vals = jnp.where(csr.valid, vals, worst)
+    # sort slots by value (best first), then stable by row: slots end up
+    # grouped by row in selection order, padding after real slots
+    key_vals = vals if select_min else -vals
+    order1 = jnp.argsort(key_vals, stable=True)
+    order2 = jnp.argsort(rows[order1], stable=True)
+    order = order1[order2]
+    sorted_rows = rows[order]
+    # within-row rank = position − first position of that row
+    counts = jnp.diff(csr.indptr)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    pos = jnp.arange(csr.cap)
+    rank = pos - starts[jnp.clip(sorted_rows, 0, n_rows)]
+    keep = (sorted_rows < n_rows) & (rank < k)
+    out_v = jnp.full((n_rows + 1, k), worst, jnp.float32)
+    out_i = jnp.full((n_rows + 1, k), -1, jnp.int32)
+    r = jnp.where(keep, sorted_rows, n_rows)
+    c = jnp.clip(rank, 0, k - 1)
+    out_v = out_v.at[r, c].set(vals[order], mode="drop")
+    out_i = out_i.at[r, c].set(csr.indices[order], mode="drop")
+    return out_v[:n_rows], out_i[:n_rows]

@@ -1,0 +1,118 @@
+"""Small-parity components: matrix misc ops, sparse select_k, IVF helpers
+(codepacker), device_resources_manager, interruptible sync wiring."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops import matrix as M
+from raft_tpu.sparse import CSR, op as sparse_op
+
+
+def test_matrix_misc_ops(rng):
+    m = jnp.asarray(rng.standard_normal((6, 5)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(M.threshold(m, 0.0)), np.where(np.asarray(m) < 0, 0, np.asarray(m))
+    )
+    np.testing.assert_allclose(
+        np.asarray(M.ratio(jnp.abs(m))),
+        np.abs(np.asarray(m)) / np.abs(np.asarray(m)).sum(),
+        rtol=1e-6,
+    )
+    r = np.asarray(M.reciprocal(m, scalar=2.0))
+    np.testing.assert_allclose(r, 2.0 / np.asarray(m), rtol=1e-6)
+    z = np.asarray(M.reciprocal(jnp.asarray([0.0, 1e-20, 2.0]), setzero=True))
+    assert z[0] == 0 and z[1] == 0 and abs(z[2] - 0.5) < 1e-6
+
+    s = np.asarray(M.sign_flip(m))
+    for c in range(s.shape[1]):
+        assert s[np.argmax(np.abs(s[:, c])), c] > 0
+
+    np.testing.assert_array_equal(np.asarray(M.triangular(m)), np.triu(np.asarray(m)))
+    np.testing.assert_array_equal(
+        np.asarray(M.triangular(m, upper=False)), np.tril(np.asarray(m))
+    )
+    np.testing.assert_array_equal(np.asarray(M.eye(3, 5)), np.eye(3, 5, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(M.diagonal(m)), np.diagonal(np.asarray(m)))
+    d = np.asarray(M.set_diagonal(m, 7.0))
+    assert (np.diagonal(d) == 7.0).all()
+    np.testing.assert_array_equal(np.asarray(M.reverse(m)), np.asarray(m)[::-1])
+
+
+def test_sparse_select_k(rng):
+    dense = rng.random((25, 30)) * (rng.random((25, 30)) < 0.4)
+    csr = CSR.from_dense(dense.astype(np.float32))
+    v, i = sparse_op.select_k(csr, 4)
+    for r in range(25):
+        stored = dense[r][dense[r] != 0]
+        want = np.sort(stored)[::-1][:4]
+        got = np.asarray(v[r])
+        got = got[np.isfinite(got)]
+        np.testing.assert_allclose(np.sort(got)[::-1], want.astype(np.float32), rtol=1e-6)
+        # returned column ids must point at the returned values
+        for j in range(len(got)):
+            assert abs(dense[r, int(i[r, j])] - float(v[r, j])) < 1e-6
+
+
+def test_ivf_helpers_roundtrip(rng):
+    from raft_tpu.neighbors import helpers, ivf_flat, ivf_pq
+
+    x = rng.random((2000, 32)).astype(np.float32)
+    fl = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), x)
+    vecs, ids = helpers.ivf_flat_unpack_list(fl, 0)
+    assert vecs.shape[0] == ids.shape[0] == int(fl.list_sizes[0])
+    np.testing.assert_allclose(vecs, x[ids], rtol=1e-6)
+
+    pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=4), x)
+    codes, ids = helpers.ivf_pq_unpack_list(pq, 0)
+    assert codes.shape == (int(pq.list_sizes[0]), pq.pq_dim)
+    packed = helpers.ivf_pq_pack_codes(codes, pq.pq_bits)
+    back = helpers.ivf_pq_unpack_codes(packed, pq.pq_dim, pq.pq_bits)
+    np.testing.assert_array_equal(back, codes)
+
+    recon, rids = helpers.ivf_pq_reconstruct_list(pq, 0)
+    # PQ reconstruction approximates the original rows
+    err = np.linalg.norm(np.asarray(recon) - x[rids], axis=1)
+    base = np.linalg.norm(x[rids], axis=1)
+    assert float(np.median(err / np.maximum(base, 1e-9))) < 0.5
+
+
+def test_device_resources_manager():
+    from raft_tpu.core import manager
+
+    manager.reset()
+    manager.set_workspace_limit(1 << 20)
+    r0 = manager.get_device_resources(0)
+    assert r0.workspace_limit_bytes == 1 << 20
+    assert manager.get_device_resources(0) is r0  # pooled
+    r1 = manager.get_device_resources(1)
+    assert r1 is not r0
+    with pytest.raises(RuntimeError):
+        manager.set_workspace_limit(2 << 20)  # frozen after first use
+    manager.reset()
+
+
+def test_interruptible_sync_cancellation():
+    from raft_tpu.core import interruptible
+    from raft_tpu.core.resources import Resources
+
+    res = Resources()
+    res.sync()  # no-op when not cancelled
+
+    tid = threading.get_ident()
+    done = []
+
+    def canceller():
+        interruptible.cancel(tid)
+        done.append(True)
+
+    t = threading.Thread(target=canceller)
+    t.start()
+    t.join()
+    assert done
+    with pytest.raises(InterruptedError):
+        res.sync()
+    res.sync()  # flag cleared by the failed check (reference behavior)
