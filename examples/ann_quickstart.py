@@ -32,9 +32,9 @@ def main() -> None:
     ap.add_argument("--platform", default="", help="e.g. cpu to force the CPU backend")
     args = ap.parse_args()
 
-    if args.platform:
-        import jax
+    import jax
 
+    if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
     from raft_tpu.core.resources import Resources
@@ -42,8 +42,6 @@ def main() -> None:
     from raft_tpu.neighbors.refine import refine
     from raft_tpu.random import make_blobs
     from raft_tpu.stats import neighborhood_recall
-
-    import jax
 
     res = Resources(workspace_limit_bytes=512 << 20)
     key = jax.random.PRNGKey(0)
@@ -55,7 +53,7 @@ def main() -> None:
 
     print(f"dataset {x.shape}, queries {q.shape}, k={args.k}")
     t0 = time.perf_counter()
-    gt_d, gt_i = brute_force.knn(x, q, args.k, res=res)
+    _, gt_i = brute_force.knn(x, q, args.k, res=res)
     gt = np.asarray(gt_i)
     print(f"brute-force ground truth: {time.perf_counter() - t0:.2f}s")
 

@@ -59,6 +59,12 @@ def get_token(thread_id: Optional[int] = None):
 
 def cancel(thread_id: int) -> None:
     """Cancel another thread's next sync (ref: interruptible::cancel)."""
+    # prune entries of dead threads so idents recycled by the OS can't
+    # inherit stale tokens and the table stays bounded in pool services
+    live = {t.ident for t in threading.enumerate()}
+    with _lock:
+        for tid in [t for t in _tokens if t not in live]:
+            del _tokens[tid]
     get_token(thread_id).cancel()
 
 
@@ -69,4 +75,11 @@ def check() -> None:
     with _lock:
         tok = _tokens.get(tid)
     if tok is not None:
-        tok.check()
+        try:
+            tok.check()
+        except InterruptedError:
+            # consumed: drop the entry so the flag can't leak to a future
+            # thread that recycles this ident
+            with _lock:
+                _tokens.pop(tid, None)
+            raise

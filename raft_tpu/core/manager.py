@@ -50,13 +50,13 @@ def get_device_resources(device_id: int = 0) -> Resources:
     device_resources_manager::get_device_resources)."""
     global _frozen
     with _lock:
-        _frozen = True
         if device_id not in _pool:
             devs = jax.local_devices()
             if not 0 <= device_id < len(devs):
                 raise ValueError(
                     f"device_id {device_id} out of range ({len(devs)} local devices)"
                 )
+            _frozen = True  # only after validation: a bad id must not freeze
             _pool[device_id] = Resources(
                 device=devs[device_id],
                 seed=_defaults["seed"] + device_id,

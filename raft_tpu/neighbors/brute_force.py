@@ -118,6 +118,39 @@ def knn(
             f"queries shape {queries.shape} incompatible with dataset dim {d}"
         )
 
+    # Pallas fused distance+topk path (ref: the fusedL2Knn fast path,
+    # spatial/knn/detail/fused_l2_knn-inl.cuh — fuses the distance tile and
+    # selection so the [n_q, n] score matrix never reaches HBM). Opt-in via
+    # RAFT_TPU_PALLAS=1 until the on-chip A/B vs the XLA formulation is
+    # recorded (bench/prims); interpret mode keeps it testable on CPU.
+    import os as _os
+
+    canonical_f32 = dataset.dtype == jnp.float32 and queries.dtype == jnp.float32
+    if (
+        _os.environ.get("RAFT_TPU_PALLAS") == "1"
+        and canonical in ("sqeuclidean", "euclidean", "inner_product")
+        and k <= 128
+        and canonical_f32
+    ):
+        from raft_tpu.kernels import interpret_mode
+        from raft_tpu.kernels.fused_knn import fused_l2_topk
+
+        if canonical == "inner_product":
+            vals, idx = fused_l2_topk(
+                queries, dataset, jnp.zeros(n), int(k), mode="ip",
+                interpret=interpret_mode(),
+            )
+            return -vals, idx
+        xx = jnp.sum(dataset * dataset, axis=1)
+        vals, idx = fused_l2_topk(
+            queries, dataset, xx, int(k), interpret=interpret_mode()
+        )
+        q2 = jnp.sum(queries * queries, axis=1)
+        vals = jnp.maximum(vals + q2[:, None], 0.0)
+        if canonical == "euclidean":
+            vals = jnp.sqrt(vals)
+        return vals, idx
+
     # tile sizing against workspace (ref: knn_brute_force.cuh tile sizing).
     # Expanded metrics materialize [query_tile, tile_cols]; unexpanded ones
     # materialize the [query_tile, tile_cols, d] broadcast, so the per-column

@@ -147,9 +147,9 @@ def select_k(csr: CSR, k: int, *, select_min: bool = False) -> Tuple[jax.Array, 
     order2 = jnp.argsort(rows[order1], stable=True)
     order = order1[order2]
     sorted_rows = rows[order]
-    # within-row rank = position − first position of that row
-    counts = jnp.diff(csr.indptr)
-    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    # within-row rank = position − first position of that row; row starts
+    # are exactly indptr (indptr[0] == 0 per the CSR contract)
+    starts = csr.indptr
     pos = jnp.arange(csr.cap)
     rank = pos - starts[jnp.clip(sorted_rows, 0, n_rows)]
     keep = (sorted_rows < n_rows) & (rank < k)

@@ -16,8 +16,27 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", False)
 
+import os  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Slow (10^5-row scale) tests run only when explicitly requested —
+    locally via RAFT_TPU_RUN_SLOW=1, or in the TPU bench environment
+    (mirrors the reference's split between unit suites and the large
+    ann-bench datasets)."""
+    if os.environ.get("RAFT_TPU_RUN_SLOW"):
+        return
+    skip = pytest.mark.skip(reason="slow scale test; set RAFT_TPU_RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: large-scale (10^5+ rows) tests")
 
 
 @pytest.fixture

@@ -48,7 +48,9 @@ def test_build_properties(built, data):
     np.testing.assert_allclose(r @ r.T, np.eye(built.rot_dim), atol=1e-4)
 
 
-@pytest.mark.parametrize("n_probes,min_recall", [(10, 0.7), (50, 0.8)])
+# gates at reference levels (ref: cpp/test/neighbors/ann_ivf_pq/ suites use
+# min_recall >= 0.85); measured headroom here is ~0.88 (PQ-distortion bound)
+@pytest.mark.parametrize("n_probes,min_recall", [(10, 0.85), (50, 0.85)])
 def test_recall_vs_bruteforce(built, data, n_probes, min_recall):
     x, q = data
     k = 10
@@ -161,4 +163,4 @@ def test_lut_bf16(built, data):
     _, idx = ivf_pq.search(
         ivf_pq.SearchParams(n_probes=50, lut_dtype="bfloat16"), built, q, 10
     )
-    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.75
+    assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.85

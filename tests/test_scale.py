@@ -1,0 +1,87 @@
+"""Scale tests: 10^5-row recall + QPS per index family.
+
+Mirrors the reference's large parameterized ANN suites
+(cpp/test/neighbors/ann_ivf_pq/, ann_ivf_flat/, ann_cagra/ run up to
+10^5-10^6 rows with min_recall gates; ann_utils.cuh:125-207). Marked slow —
+run with RAFT_TPU_RUN_SLOW=1 (CPU: ~minutes; intended for the TPU bench
+environment where builds take seconds).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.random import make_blobs
+from raft_tpu.stats import neighborhood_recall
+
+pytestmark = pytest.mark.slow
+
+N, D, N_Q, K = 100_000, 64, 1_000, 10
+
+
+@pytest.fixture(scope="module")
+def scale_data():
+    key = jax.random.PRNGKey(7)
+    x, _, centers = make_blobs(key, N, D, n_clusters=512, cluster_std=1.0)
+    q, _, _ = make_blobs(jax.random.PRNGKey(8), N_Q, D, centers=centers)
+    res = Resources(workspace_limit_bytes=1 << 30)
+    gt_d, gt_i = brute_force.knn(x, q, K, res=res)
+    return np.asarray(x), np.asarray(q), np.asarray(gt_i), res
+
+
+def _qps(fn, *args, iters=3):
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return N_Q / ((time.perf_counter() - t0) / iters)
+
+
+def test_ivf_flat_100k(scale_data):
+    x, q, gt, res = scale_data
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=1024, kmeans_n_iters=10), x, res=res
+    )
+    sp = ivf_flat.SearchParams(n_probes=32)
+    _, ids = ivf_flat.search(sp, index, q, K, res=res)
+    r = float(neighborhood_recall(np.asarray(ids), gt))
+    qps = _qps(lambda: ivf_flat.search(sp, index, q, K, res=res))
+    print(f"\nivf_flat 100k: recall={r:.4f} qps={qps:.0f}")
+    assert r >= 0.9
+
+
+def test_ivf_pq_100k(scale_data):
+    x, q, gt, res = scale_data
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=1024, pq_dim=D // 2, kmeans_n_iters=10),
+        x,
+        res=res,
+    )
+    sp = ivf_pq.SearchParams(n_probes=32, lut_dtype="bfloat16")
+
+    def search(qq):
+        _, cand = ivf_pq.search(sp, index, qq, K * 4, res=res)
+        return refine(x, qq, cand, K, res=res)
+
+    _, ids = search(q)
+    r = float(neighborhood_recall(np.asarray(ids), gt))
+    qps = _qps(search, q)
+    print(f"\nivf_pq 100k: recall={r:.4f} qps={qps:.0f}")
+    assert r >= 0.9
+
+
+def test_cagra_100k(scale_data):
+    x, q, gt, res = scale_data
+    index = cagra.build(cagra.IndexParams(graph_degree=32), x, res=res)
+    sp = cagra.SearchParams(itopk_size=64)
+    _, ids = cagra.search(sp, index, q, K, res=res)
+    r = float(neighborhood_recall(np.asarray(ids), gt))
+    qps = _qps(lambda: cagra.search(sp, index, q, K, res=res))
+    print(f"\ncagra 100k: recall={r:.4f} qps={qps:.0f}")
+    assert r >= 0.9
