@@ -7,6 +7,8 @@ async-dispatch analog of the reference's stream sync."""
 
 from __future__ import annotations
 
+import functools
+import inspect
 from typing import Optional
 
 import jax
@@ -71,3 +73,81 @@ def to_device_array(x) -> jax.Array:
     if isinstance(x, device_ndarray):
         return x.array
     return jnp.asarray(x)
+
+
+class cai_wrapper:
+    """Array-attribute wrapper (ref: pylibraft/common/cai_wrapper.py:21 —
+    there reads __cuda_array_interface__; here any array-like via the
+    device bridge, exposing the same .dtype/.shape/.c_contiguous surface)."""
+
+    def __init__(self, x):
+        self._array = to_device_array(x)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype.name)
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape)
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True  # XLA arrays are dense row-major
+
+    @property
+    def array(self) -> jax.Array:
+        return self._array
+
+
+# host-array twin (ref: pylibraft/common/ai_wrapper.py — __array_interface__)
+ai_wrapper = cai_wrapper
+
+
+def auto_sync_handle(fn):
+    """Decorator: default + sync the handle around the call
+    (ref: pylibraft/common/auto_sync_handle — injects a handle kwarg and
+    syncs it after the wrapped call when it was auto-created). Handles
+    passed positionally are honored via signature binding."""
+
+    sig = inspect.signature(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        bound = sig.bind_partial(*args, **kwargs)
+        created = bound.arguments.get("handle") is None
+        if created:
+            bound.arguments["handle"] = DeviceResources()
+        out = fn(*bound.args, **bound.kwargs)
+        if created:
+            bound.arguments["handle"].sync()
+        return out
+
+    return wrapper
+
+
+def auto_convert_output(fn):
+    """Decorator applying config.set_output_as to array returns
+    (ref: pylibraft/common/auto_convert_output). Tuple returns keep their
+    type (NamedTuples included)."""
+
+    from raft_tpu.compat.pylibraft import config
+
+    def _conv(x):
+        if isinstance(x, jax.Array):
+            return config.convert_output(x)
+        if isinstance(x, tuple):
+            vals = [_conv(v) for v in x]
+            # NamedTuple subclasses construct from positional fields
+            return type(x)(*vals) if hasattr(x, "_fields") else tuple(vals)
+        if isinstance(x, list):
+            return [_conv(v) for v in x]
+        if isinstance(x, dict):
+            return {k: _conv(v) for k, v in x.items()}
+        return x
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return _conv(fn(*args, **kwargs))
+
+    return wrapper

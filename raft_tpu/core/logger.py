@@ -1,0 +1,50 @@
+"""Framework logging — RAFT_LOG_* parity.
+
+Reference: ``core/logger-inl.hpp:72-110`` (spdlog singleton, runtime level,
+callback sink) with ``RAFT_LOG_*`` macros used inside algorithms (e.g.
+cagra's search_plan.cuh:119). Here: a standard ``logging`` logger named
+``raft_tpu`` that algorithms emit structured debug lines through, plus a
+bridge that forwards the native C++ core's log records into the same
+logger so Python and C++ logs interleave in one stream
+(ref: core/detail/callback_sink.hpp Python integration).
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("raft_tpu")
+
+# native levels (cpp/include/raft_tpu/core/logger.hpp) → logging levels
+_NATIVE_TO_PY = {
+    0: logging.CRITICAL,  # off → nothing should arrive, map high
+    1: logging.CRITICAL,
+    2: logging.ERROR,
+    3: logging.WARNING,
+    4: logging.INFO,
+    5: logging.DEBUG,
+    6: logging.DEBUG,  # trace
+}
+
+_bridged = False
+
+
+def get_logger() -> logging.Logger:
+    return logger
+
+
+def bridge_native() -> bool:
+    """Route the native core's log records into the ``raft_tpu`` logger.
+    Returns False when no native toolchain is available. Idempotent."""
+    global _bridged
+    if _bridged:
+        return True
+    from raft_tpu.core import native
+
+    if not native.available():
+        return False
+    native.log_set_callback(
+        lambda lvl, msg: logger.log(_NATIVE_TO_PY.get(lvl, logging.INFO), "%s", msg)
+    )
+    _bridged = True
+    return True

@@ -46,6 +46,7 @@ from raft_tpu.neighbors._common import sorted_id_dedup
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
+from raft_tpu.core.logger import logger as _log
 
 _SERIALIZATION_VERSION = 1
 
@@ -285,6 +286,10 @@ def build(
         raise ValueError(f"unknown build_algo {params.build_algo}")
 
     graph = optimize(knn_graph, degree, res=res)
+    _log.debug(
+        "cagra.build: n=%d dim=%d degree=%d algo=%s dtype=%s",
+        n, d, graph.shape[1], algo, dataset.dtype,
+    )
     return Index(params.metric, dataset, graph)
 
 

@@ -49,6 +49,7 @@ from raft_tpu.neighbors._common import (
 )
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
+from raft_tpu.core.logger import logger as _log
 
 _SERIALIZATION_VERSION = 1
 
@@ -176,6 +177,11 @@ def build(
     )
     if params.add_data_on_build:
         index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
+    _log.debug(
+        "ivf_flat.build: n=%d dim=%d n_lists=%d (requested %d) cap=%d dtype=%s",
+        n, d, index.n_lists, params.n_lists, index.list_cap,
+        index.list_data.dtype,
+    )
     return index
 
 

@@ -67,6 +67,7 @@ from raft_tpu.neighbors._common import (
 )
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
+from raft_tpu.core.logger import logger as _log
 
 _SERIALIZATION_VERSION = 1
 
@@ -415,6 +416,12 @@ def build(
     )
     if params.add_data_on_build:
         index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
+    _log.debug(
+        "ivf_pq.build: n=%d dim=%d n_lists=%d (requested %d) pq_dim=%d "
+        "pq_bits=%d cap=%d",
+        n, dim, index.n_lists, params.n_lists, pq_dim, params.pq_bits,
+        index.list_cap,
+    )
     return index
 
 

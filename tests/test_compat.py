@@ -138,3 +138,60 @@ def test_device_ndarray(rng):
     np.testing.assert_array_equal(d.copy_to_host(), a)
     out = distance.pairwise_distance(d, d)
     assert out.shape == (5, 5)
+
+
+def test_cai_wrapper_and_decorators():
+    """(ref: pylibraft cai_wrapper/auto_sync_handle/auto_convert_output)"""
+    import numpy as np
+
+    from raft_tpu.compat.pylibraft import config
+    from raft_tpu.compat.pylibraft.common import (
+        DeviceResources,
+        auto_convert_output,
+        auto_sync_handle,
+        cai_wrapper,
+        device_ndarray,
+    )
+
+    w = cai_wrapper(np.ones((3, 4), np.float32))
+    assert w.shape == (3, 4) and w.dtype == np.float32 and w.c_contiguous
+    w2 = cai_wrapper(device_ndarray(np.zeros((2, 2))))
+    assert w2.shape == (2, 2)
+
+    calls = {}
+
+    @auto_sync_handle
+    def fn(x, handle=None):
+        calls["handle"] = handle
+        return x
+
+    assert fn(5) == 5
+    assert isinstance(calls["handle"], DeviceResources)
+
+    @auto_convert_output
+    def gn():
+        import jax.numpy as jnp
+
+        return jnp.ones(3), "meta"
+
+    config.set_output_as("numpy")
+    try:
+        out, meta = gn()
+        assert isinstance(out, np.ndarray) and meta == "meta"
+    finally:
+        config.set_output_as("jax")
+
+
+def test_logger_bridge_and_algorithm_logs(caplog):
+    import logging
+
+    import numpy as np
+
+    from raft_tpu.core.logger import bridge_native, get_logger
+    from raft_tpu.neighbors import ivf_flat
+
+    bridge_native()  # False is fine when no toolchain; must not raise
+    x = np.random.default_rng(0).random((500, 16)).astype(np.float32)
+    with caplog.at_level(logging.DEBUG, logger="raft_tpu"):
+        ivf_flat.build(ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2), x)
+    assert any("ivf_flat.build" in r.message for r in caplog.records)

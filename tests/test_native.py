@@ -123,3 +123,20 @@ def test_resources_native_backing():
     assert nat.workspace_used >= 1024
     nat.workspace_free(p)
     assert res.native is nat  # cached on the registry
+
+
+def test_header_compile_surface():
+    """Every public C++ header compiles standalone (ref: the reference's
+    ext_headers targets, cpp/test/CMakeLists.txt:204-205)."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    cpp = os.path.join(os.path.dirname(os.path.dirname(__file__)), "cpp")
+    out = subprocess.run(
+        ["make", "-C", cpp, "check-headers"], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
