@@ -78,6 +78,133 @@ def sharded_knn(
     return f(dataset_sharded, queries)
 
 
+def shard_ivf_pq_index(comms: Comms, index) -> dict:
+    """Shard an IVF-PQ index list-wise across the comms axis.
+
+    The MNMG ANN pattern (ref: SURVEY §5 'distributed communication
+    backend' — shard indexes across the mesh, merge per-shard top-k):
+    lists (and their decoded scan rows) are distributed over devices; the
+    coarse centroids travel with their lists so each shard probes locally.
+    Lists are padded to a multiple of the axis size with empty lists whose
+    centroids are masked out of coarse selection.
+    """
+    from jax.sharding import NamedSharding
+
+    size = comms.get_size()
+    L = index.n_lists
+    L_pad = -(-L // size) * size
+    pad = L_pad - L
+
+    def dev_put(arr, spec):
+        return jax.device_put(arr, NamedSharding(comms.mesh, spec))
+
+    axis = comms.axis
+    centers = jnp.pad(index.centers, ((0, pad), (0, 0)))
+    data = jnp.pad(index.list_data, ((0, pad), (0, 0), (0, 0)))
+    y2 = jnp.pad(index.list_y2, ((0, pad), (0, 0)))
+    ids = jnp.pad(index.list_index, ((0, pad), (0, 0)), constant_values=-1)
+    valid = jnp.arange(L_pad) < L
+    return {
+        "centers": dev_put(centers, P(axis, None)),
+        "list_data": dev_put(data, P(axis, None, None)),
+        "list_y2": dev_put(y2, P(axis, None)),
+        "list_index": dev_put(ids, P(axis, None)),
+        "list_valid": dev_put(valid, P(axis)),
+        "rotation": dev_put(index.rotation, P(None, None)),
+        "metric": index.metric,
+    }
+
+
+def sharded_ivf_pq_search(
+    comms: Comms,
+    sharded: dict,
+    queries: jax.Array,
+    k: int,
+    *,
+    n_probes: int = 20,
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed IVF-PQ search: each shard probes ``n_probes`` of its own
+    lists and scans them; per-shard top-k results (global dataset ids) are
+    all-gathered and re-selected — the knn_merge_parts-equivalent collective
+    (ref: the reference's MNMG search = local search + merge; BASELINE
+    config #5 distributed IVF-PQ).
+
+    Returns replicated (distances [q, k], ids [q, k]).
+    """
+    from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
+
+    metric = DISTANCE_TYPES[sharded["metric"]]
+    mesh, axis = comms.mesh, comms.axis
+    size = comms.get_size()
+    L_shard = sharded["centers"].shape[0] // size
+    cap = sharded["list_data"].shape[1]
+    p_local = min(n_probes, L_shard)
+    k_local = min(k, p_local * cap)
+    if size * k_local < k:
+        raise ValueError(
+            f"k={k} exceeds the global candidate pool "
+            f"{size}*{k_local} (shards*probed slots); raise n_probes"
+        )
+    queries = jnp.asarray(queries, jnp.float32)
+
+    def local(centers_s, valid_s, data_s, y2_s, ids_s, rot, q):
+        # coarse over this shard's lists, empty-padding masked out
+        if metric == "inner_product":
+            coarse = -jnp.matmul(q, centers_s.T, precision=_PREC)
+        else:
+            c2 = jnp.sum(centers_s * centers_s, axis=1)
+            coarse = c2[None, :] - 2.0 * jnp.matmul(q, centers_s.T, precision=_PREC)
+        coarse = jnp.where(valid_s[None, :], coarse, jnp.inf)
+        _, probes = select_k(coarse, p_local, select_min=True)
+
+        q_rot = jnp.matmul(q, rot.T, precision=_PREC)
+        # scan in the stored dtype (bf16 by default — same HBM-halving path
+        # as the single-device kernel); f32 accumulation via preferred type
+        dec = data_s[probes]                              # [q, p, cap, rot]
+        ids = ids_s[probes]                               # [q, p, cap]
+        y2 = y2_s[probes]
+        ip = lax.dot_general(
+            q_rot.astype(dec.dtype), dec, (((1,), (3,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        if metric == "inner_product":
+            scores = -ip
+        else:
+            qq = jnp.sum(q_rot * q_rot, axis=1)
+            scores = y2 - 2.0 * ip + qq[:, None, None]
+        scores = jnp.where(ids < 0, jnp.inf, scores)
+        flat_s = scores.reshape(q.shape[0], p_local * cap)
+        flat_i = jnp.where(ids < 0, -1, ids).reshape(q.shape[0], p_local * cap)
+        v, i = select_k(flat_s, k_local, select_min=True, input_indices=flat_i)
+        if k_local < k:
+            v = jnp.pad(v, ((0, 0), (0, k - k_local)), constant_values=jnp.inf)
+            i = jnp.pad(i, ((0, 0), (0, k - k_local)), constant_values=-1)
+        # merge across shards (global ids already)
+        vg = lax.all_gather(v, axis, axis=1, tiled=True)
+        ig = lax.all_gather(i, axis, axis=1, tiled=True)
+        v, i = select_k(vg, k, select_min=True, input_indices=ig)
+        if metric == "inner_product":
+            v = -v
+        elif metric == "euclidean":
+            v = jnp.sqrt(jnp.maximum(v, 0.0))
+        return v, i
+
+    f = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None), P(axis), P(axis, None, None), P(axis, None),
+            P(axis, None), P(None, None), P(None, None),
+        ),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    return f(
+        sharded["centers"], sharded["list_valid"], sharded["list_data"],
+        sharded["list_y2"], sharded["list_index"], sharded["rotation"], queries,
+    )
+
+
 def kmeans_step(
     comms: Comms,
     data_sharded: jax.Array,

@@ -54,7 +54,7 @@ class device_ndarray:
 
     @property
     def dtype(self):
-        return np.dtype(self._array.dtype.name)
+        return np.dtype(self._array.dtype)
 
     def copy_to_host(self) -> np.ndarray:
         return np.asarray(self._array)
@@ -85,7 +85,8 @@ class cai_wrapper:
 
     @property
     def dtype(self):
-        return np.dtype(self._array.dtype.name)
+        # ml_dtypes-aware (bf16 etc.): jax dtypes ARE numpy dtype objects
+        return np.dtype(self._array.dtype)
 
     @property
     def shape(self):
