@@ -138,6 +138,7 @@ def sharded_ivf_pq_search(
     size = comms.get_size()
     L_shard = sharded["centers"].shape[0] // size
     cap = sharded["list_data"].shape[1]
+    rot_dim = sharded["list_data"].shape[2]
     p_local = min(n_probes, L_shard)
     k_local = min(k, p_local * cap)
     if size * k_local < k:
@@ -146,6 +147,19 @@ def sharded_ivf_pq_search(
             f"{size}*{k_local} (shards*probed slots); raise n_probes"
         )
     queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != sharded["centers"].shape[1]:
+        raise ValueError(
+            f"queries shape {queries.shape} vs index dim "
+            f"{sharded['centers'].shape[1]}"
+        )
+    # bound the per-shard [tile, p, cap, rot] gather against the workspace
+    # (same sizing rule as the single-device _search_jit query tiling)
+    from raft_tpu.core.resources import ensure as _ensure
+
+    ws = _ensure(None).workspace_limit_bytes
+    itemsize = jnp.dtype(sharded["list_data"].dtype).itemsize
+    per_q = max(1, p_local * cap * (rot_dim * itemsize + 12))
+    query_tile = int(min(queries.shape[0], max(1, ws // per_q)))
 
     def local(centers_s, valid_s, data_s, y2_s, ids_s, rot, q):
         # coarse over this shard's lists, empty-padding masked out
@@ -172,9 +186,10 @@ def sharded_ivf_pq_search(
         else:
             qq = jnp.sum(q_rot * q_rot, axis=1)
             scores = y2 - 2.0 * ip + qq[:, None, None]
+        # padding slots already carry id −1; +inf scores keep them losing
         scores = jnp.where(ids < 0, jnp.inf, scores)
         flat_s = scores.reshape(q.shape[0], p_local * cap)
-        flat_i = jnp.where(ids < 0, -1, ids).reshape(q.shape[0], p_local * cap)
+        flat_i = ids.reshape(q.shape[0], p_local * cap)
         v, i = select_k(flat_s, k_local, select_min=True, input_indices=flat_i)
         if k_local < k:
             v = jnp.pad(v, ((0, 0), (0, k - k_local)), constant_values=jnp.inf)
@@ -199,10 +214,28 @@ def sharded_ivf_pq_search(
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
     )
-    return f(
-        sharded["centers"], sharded["list_valid"], sharded["list_data"],
-        sharded["list_y2"], sharded["list_index"], sharded["rotation"], queries,
-    )
+    n_q = queries.shape[0]
+    if query_tile >= n_q:
+        return f(
+            sharded["centers"], sharded["list_valid"], sharded["list_data"],
+            sharded["list_y2"], sharded["list_index"], sharded["rotation"],
+            queries,
+        )
+    # host-level query batching; pad the tail so every call shares one
+    # compiled shape
+    vs, is_ = [], []
+    for s in range(0, n_q, query_tile):
+        qq = queries[s : s + query_tile]
+        pad = query_tile - qq.shape[0]
+        if pad:
+            qq = jnp.pad(qq, ((0, pad), (0, 0)))
+        v, i = f(
+            sharded["centers"], sharded["list_valid"], sharded["list_data"],
+            sharded["list_y2"], sharded["list_index"], sharded["rotation"], qq,
+        )
+        vs.append(v[: v.shape[0] - pad] if pad else v)
+        is_.append(i[: i.shape[0] - pad] if pad else i)
+    return jnp.concatenate(vs), jnp.concatenate(is_)
 
 
 def kmeans_step(
