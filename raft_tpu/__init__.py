@@ -36,6 +36,38 @@ sharding via `jax.sharding.Mesh` + shard_map.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at a package-local directory.
+
+    Cold-process XLA compiles dominate wall time for index builds (measured:
+    148 s cold vs 4 s warm for a 100k-row IVF-PQ build through the TPU
+    tunnel), so caching compiled executables across processes is the single
+    biggest end-to-end speedup available. Opt out with
+    ``RAFT_TPU_NO_COMPILE_CACHE=1``; override the location with
+    ``RAFT_TPU_CACHE_DIR``. No-ops gracefully on JAX versions without the
+    config knobs.
+    """
+    if _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE"):
+        return
+    import jax
+
+    cache_dir = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), _os.pardir, ".jax_cache"
+    )
+    try:
+        _os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _os.path.abspath(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - old JAX or read-only filesystem
+        pass
+
+
+_enable_persistent_compile_cache()
+
 from raft_tpu.core.resources import Resources, DeviceResources, default_resources
 
 __all__ = [

@@ -51,6 +51,17 @@ def _maybe_normalize(x: jax.Array, metric: str) -> jax.Array:
     return x
 
 
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _predict_jit(centers, x, metric: str):
+    x = _maybe_normalize(x.astype(jnp.float32), metric)
+    c = _maybe_normalize(centers.astype(jnp.float32), metric)
+    if metric == "inner_product":
+        d = -jnp.matmul(x, c.T, precision=jax.lax.Precision.HIGHEST)
+    else:
+        d = distance_matrix_tile(x, c, "sqeuclidean")
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+
 @traced("kmeans_balanced.predict")
 def predict(
     centers: jax.Array,
@@ -63,13 +74,7 @@ def predict(
     predict_core :83-164, which uses fusedL2NNMinReduce for L2 and
     pairwise_distance+argmin for other metrics — the metric MUST match the
     one used at build so list membership and probe ranking agree)."""
-    x = _maybe_normalize(jnp.asarray(x, jnp.float32), metric)
-    c = _maybe_normalize(jnp.asarray(centers, jnp.float32), metric)
-    if metric == "inner_product":
-        d = -jnp.matmul(x, c.T, precision=jax.lax.Precision.HIGHEST)
-    else:
-        d = distance_matrix_tile(x, c, "sqeuclidean")
-    return jnp.argmin(d, axis=1).astype(jnp.int32)
+    return _predict_jit(jnp.asarray(centers), jnp.asarray(x), metric)
 
 
 @functools.partial(jax.jit, static_argnames=("n_iters", "n_clusters", "metric"))
@@ -207,8 +212,14 @@ def fit(
     # mesoclusters at once (one dispatch instead of n_meso sequential fits);
     # padding repeats the mesocluster's own members (weight 0) so random
     # seeds/teleports can never land outside the partition
-    max_members = int(counts.max())
-    max_fine = int(fine_k.max())
+    # bucket the padded shapes to stable sizes (next power of two members,
+    # sublane-multiple fine count): the vmapped fine fit is compiled per
+    # (max_members, max_fine) signature, and raw data-dependent values force
+    # a fresh XLA compile for every dataset — measured 27 s per recompile
+    # through the TPU tunnel. Extra lanes are weight-0 padding.
+    max_members = min(int(counts.max()), n)
+    max_members = 1 << max(5, (max_members - 1).bit_length())
+    max_fine = int(-(-int(fine_k.max()) // 8) * 8)
     occ = np.nonzero((counts > 0) & (fine_k > 0))[0]
     sel = np.empty((len(occ), max_members), np.int64)
     wts = np.zeros((len(occ), max_members), np.float32)

@@ -12,6 +12,7 @@ from raft_tpu.core.resources import (
 )
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core import serialize
+from raft_tpu.core.validation import RaftError, LogicError, expects, fail
 
 __all__ = [
     "Resources",
@@ -20,4 +21,8 @@ __all__ = [
     "set_default_resources",
     "Bitset",
     "serialize",
+    "RaftError",
+    "LogicError",
+    "expects",
+    "fail",
 ]

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core import validation
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.core.trace import traced
 
@@ -263,10 +264,10 @@ def pairwise_distance(
     res = ensure(res)
     x = jnp.asarray(x)
     y = x if y is None else jnp.asarray(y)
-    if metric not in DISTANCE_TYPES:
-        raise ValueError(f"unsupported metric {metric!r}; one of {sorted(DISTANCE_TYPES)}")
-    if x.ndim != 2 or y.ndim != 2 or x.shape[1] != y.shape[1]:
-        raise ValueError(f"incompatible shapes {x.shape} vs {y.shape}")
+    validation.check_in(metric, DISTANCE_TYPES, "metric")
+    validation.check_matrix(x, "x")
+    validation.check_matrix(y, "y")
+    validation.check_same_cols(x, y)
     canonical = DISTANCE_TYPES[metric]
     n, d = y.shape
     if canonical in _EXPANDED or canonical == "haversine":

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import validation
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, distance_matrix_tile
 from raft_tpu.ops.matrix import select_k
@@ -108,15 +109,18 @@ def knn(
     res = ensure(res)
     dataset = jnp.asarray(dataset)
     queries = jnp.asarray(queries)
-    if metric not in DISTANCE_TYPES:
-        raise ValueError(f"unsupported metric {metric!r}; one of {sorted(DISTANCE_TYPES)}")
+    validation.check_in(metric, DISTANCE_TYPES, "metric")
+    validation.check_matrix(dataset, "dataset")
+    validation.check_matrix(queries, "queries")
+    validation.check_same_cols(dataset, queries, "dataset", "queries")
+    validation.check_positive(k, "k")
+    validation.expects(
+        k <= dataset.shape[0],
+        f"k={k} larger than dataset size {dataset.shape[0]}",
+    )
     canonical = DISTANCE_TYPES[metric]
     select_min = canonical != "inner_product"
     n, d = dataset.shape
-    if queries.ndim != 2 or queries.shape[1] != d:
-        raise ValueError(
-            f"queries shape {queries.shape} incompatible with dataset dim {d}"
-        )
 
     # Pallas fused distance+topk path (ref: the fusedL2Knn fast path,
     # spatial/knn/detail/fused_l2_knn-inl.cuh — fuses the distance tile and

@@ -39,6 +39,42 @@ def _max_identity(dtype):
     return jnp.array(jnp.iinfo(dtype).min, dtype)
 
 
+#: Row length above which the two-stage chunked select beats one wide
+#: ``lax.top_k`` (sort width drops from n to max(chunk, k·n/chunk)). The
+#: TPU-measured analog of the reference's offline-trained decision tree
+#: (ref: matrix/detail/select_k-inl.cuh:47-75); tune with
+#: ``python -m raft_tpu.bench.prims --filter select_k``.
+_CHUNKED_MIN_N = 8192
+_CHUNK = 2048
+
+
+def _select_k_chunked(scores: jax.Array, k: int, select_min: bool):
+    """Two-stage tournament select for long rows: per-chunk top-k on
+    [B, n/c, c] (one batched narrow sort) then a final top-k over the
+    k·n/c survivors. The TPU stand-in for the reference's multi-pass radix
+    path (ref: matrix/detail/select_radix.cuh) — same goal (avoid one full-
+    width sort), expressed as two batched sorts instead of histogram passes.
+    """
+    b, n = scores.shape
+    c = max(_CHUNK, 1 << (k - 1).bit_length())  # chunk must hold k
+    n_chunks = -(-n // c)
+    pad = n_chunks * c - n
+    if pad:
+        fill = _min_identity(scores.dtype) if select_min else _max_identity(scores.dtype)
+        scores = jnp.concatenate(
+            [scores, jnp.full((b, pad), fill, scores.dtype)], axis=-1
+        )
+    tiles = scores.reshape(b, n_chunks, c)
+    neg = -tiles if select_min else tiles
+    v1, i1 = lax.top_k(neg, k)                      # [b, n_chunks, k]
+    base = (jnp.arange(n_chunks, dtype=jnp.int32) * c)[None, :, None]
+    i1 = i1.astype(jnp.int32) + base
+    v2, i2 = lax.top_k(v1.reshape(b, n_chunks * k), k)
+    idx = jnp.take_along_axis(i1.reshape(b, n_chunks * k), i2, axis=-1)
+    vals = -v2 if select_min else v2
+    return vals.astype(scores.dtype), idx
+
+
 @traced("matrix.select_k")
 def select_k(
     scores: jax.Array,
@@ -47,6 +83,7 @@ def select_k(
     select_min: bool = True,
     input_indices: Optional[jax.Array] = None,
     sorted: bool = True,
+    algo: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched top-k selection (ref: matrix/select_k.cuh API).
 
@@ -59,11 +96,16 @@ def select_k(
       sorted: whether rows of the result must be sorted (ascending for
         select_min, descending otherwise). XLA top_k always sorts, so this
         is free; the flag is kept for interface parity.
+      algo: "auto" (heuristic, ref select_k-inl.cuh:47 idea), "topk"
+        (single wide ``lax.top_k``), or "chunked" (two-stage tournament,
+        the large-n analog of the reference's radix path).
 
     Returns:
       (values [batch, k], indices [batch, k]); indices are int32 positions
       into the row (or gathered from input_indices).
     """
+    if algo not in ("auto", "topk", "chunked"):
+        raise ValueError(f"unknown select_k algo {algo!r}")
     squeeze = scores.ndim == 1
     if squeeze:
         scores = scores[None, :]
@@ -71,7 +113,21 @@ def select_k(
     if k > n:
         raise ValueError(f"k={k} larger than row length {n}")
 
-    if jnp.issubdtype(scores.dtype, jnp.integer):
+    is_int = jnp.issubdtype(scores.dtype, jnp.integer)
+    if not is_int and (
+        algo == "chunked"
+        or (algo == "auto" and n >= _CHUNKED_MIN_N and k <= _CHUNK)
+    ):
+        vals, idx = _select_k_chunked(scores, k, select_min)
+        if input_indices is not None:
+            if input_indices.ndim == 1:
+                input_indices = input_indices[None, :]
+            idx = jnp.take_along_axis(input_indices, idx, axis=-1)
+        if squeeze:
+            return vals[0], idx[0]
+        return vals, idx
+
+    if is_int:
         # integers can't be safely negated (INT_MIN) or promoted to float
         # (f32 loses exactness above 2^24); use an exact argsort instead
         order = jnp.argsort(scores, axis=-1)

@@ -119,3 +119,52 @@ class TestSerialize:
         serialize.save_tree(fn, "ivf_flat", 1, {}, {})
         with pytest.raises(ValueError, match="expected"):
             serialize.load_tree(fn, "ivf_pq", 1)
+
+
+class TestValidation:
+    """RAFT_EXPECTS-style guards (ref: core/error.hpp RAFT_EXPECTS/RAFT_FAIL)."""
+
+    def test_expects_and_fail(self):
+        from raft_tpu.core import validation as v
+
+        v.expects(True, "fine")
+        with pytest.raises(v.LogicError):
+            v.expects(False, "nope")
+        with pytest.raises(v.RaftError):
+            v.fail("always")
+        # LogicError must stay a ValueError so pre-existing callers keep working
+        assert issubclass(v.LogicError, ValueError)
+
+    def test_check_helpers(self, rng):
+        from raft_tpu.core import validation as v
+
+        x = rng.random((4, 8)).astype(np.float32)
+        v.check_matrix(x, "x")
+        v.check_same_cols(x, x)
+        v.check_in("a", ("a", "b"))
+        v.check_positive(3)
+        with pytest.raises(v.LogicError):
+            v.check_matrix(x[0], "x")
+        with pytest.raises(v.LogicError):
+            v.check_matrix(x, "x", min_rows=10)
+        with pytest.raises(v.LogicError):
+            v.check_matrix(x, "x", dtypes=["int32"])
+        with pytest.raises(v.LogicError):
+            v.check_same_cols(x, rng.random((4, 9)))
+        with pytest.raises(v.LogicError):
+            v.check_in("c", ("a", "b"))
+        with pytest.raises(v.LogicError):
+            v.check_positive(0)
+
+    def test_public_entries_guarded(self, rng):
+        from raft_tpu.core import validation as v
+        from raft_tpu.distance.pairwise import pairwise_distance
+        from raft_tpu.neighbors import brute_force
+
+        x = rng.random((10, 4)).astype(np.float32)
+        with pytest.raises(v.LogicError):
+            pairwise_distance(x, metric="not-a-metric")
+        with pytest.raises(v.LogicError):
+            brute_force.knn(x, rng.random((2, 5)).astype(np.float32), 3)
+        with pytest.raises(v.LogicError):
+            brute_force.knn(x, x, k=11)

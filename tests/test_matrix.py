@@ -20,6 +20,36 @@ def test_select_k(rng, select_min, batch, n, k):
     np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6)
 
 
+@pytest.mark.parametrize("select_min", [True, False])
+@pytest.mark.parametrize(
+    "batch,n,k",
+    [(2, 10_000, 10), (1, 9000, 100), (3, 20_000, 513), (2, 8192, 2048)],
+)
+def test_select_k_chunked(rng, select_min, batch, n, k):
+    """The two-stage tournament path must agree exactly with a host sort
+    (incl. non-multiple-of-chunk n and k spanning the chunk size)."""
+    x = rng.random((batch, n)).astype(np.float32)
+    vals, idx = matrix.select_k(x, k, select_min=select_min, algo="chunked")
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    order = np.sort(x, axis=1)
+    want = order[:, :k] if select_min else order[:, ::-1][:, :k]
+    np.testing.assert_allclose(vals, want, rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6)
+
+
+def test_select_k_algo_agreement(rng):
+    """auto/topk/chunked return identical sets on distinct scores."""
+    x = rng.random((4, 12_000)).astype(np.float32)
+    out = {
+        a: np.asarray(matrix.select_k(x, 25, algo=a)[1])
+        for a in ("auto", "topk", "chunked")
+    }
+    for a in ("topk", "chunked"):
+        np.testing.assert_array_equal(np.sort(out["auto"], 1), np.sort(out[a], 1))
+    with pytest.raises(ValueError):
+        matrix.select_k(x, 5, algo="bogus")
+
+
 def test_select_k_input_indices(rng):
     x = rng.random((3, 50)).astype(np.float32)
     src = rng.integers(0, 10_000, (3, 50)).astype(np.int32)
