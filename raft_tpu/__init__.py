@@ -52,8 +52,15 @@ def _enable_persistent_compile_cache() -> None:
     """
     if _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE"):
         return
+    if _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # the user already routed the cache; don't override
     import jax
 
+    try:
+        if jax.config.jax_compilation_cache_dir is not None:
+            return  # ditto for an in-process jax.config setting
+    except AttributeError:
+        pass
     cache_dir = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.join(
         _os.path.dirname(_os.path.abspath(__file__)), _os.pardir, ".jax_cache"
     )

@@ -100,8 +100,20 @@ def shard_ivf_pq_index(comms: Comms, index) -> dict:
 
     axis = comms.axis
     centers = jnp.pad(index.centers, ((0, pad), (0, 0)))
-    data = jnp.pad(index.list_data, ((0, pad), (0, 0), (0, 0)))
-    y2 = jnp.pad(index.list_y2, ((0, pad), (0, 0)))
+    list_data = index.list_data
+    list_y2 = index.list_y2
+    if list_data.dtype == jnp.int8:
+        # the sharded scan runs in the stored dtype; dequantize the int8
+        # memory-lean cache to bf16 here (each shard holds 1/size of it) and
+        # recompute y2 from the bf16-rounded values so scores keep matching
+        # exactly what the scan kernel sees
+        list_data = (list_data.astype(jnp.float32) * index.scan_scale).astype(
+            jnp.bfloat16
+        )
+        d32 = list_data.astype(jnp.float32)
+        list_y2 = jnp.sum(d32 * d32, axis=-1)
+    data = jnp.pad(list_data, ((0, pad), (0, 0), (0, 0)))
+    y2 = jnp.pad(list_y2, ((0, pad), (0, 0)))
     ids = jnp.pad(index.list_index, ((0, pad), (0, 0)), constant_values=-1)
     valid = jnp.arange(L_pad) < L
     return {

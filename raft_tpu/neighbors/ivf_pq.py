@@ -54,6 +54,7 @@ from jax import lax
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import validation
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
@@ -73,6 +74,15 @@ _SERIALIZATION_VERSION = 1
 
 CODEBOOK_PER_SUBSPACE = "per_subspace"
 CODEBOOK_PER_CLUSTER = "per_cluster"
+
+#: scan-cache storage dtypes (the lut_dtype accuracy ladder analog,
+#: ref ivf_pq_types.hpp:139-172): bf16 = HBM-halving default, f32 = exact
+#: decode, int8 = memory-lean quantized cache (rot_dim bytes/vector).
+_DECODED_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "int8": jnp.int8,
+}
 
 
 @dataclass
@@ -132,6 +142,7 @@ class Index:
     def __init__(
         self, metric, codebook_kind, pq_bits, centers, centers_rot, rotation,
         codebook, list_codes, list_index, list_sizes, list_data, list_y2,
+        scan_scale: float = 1.0,
     ):
         self.metric = metric
         self.codebook_kind = codebook_kind
@@ -145,6 +156,8 @@ class Index:
         self.list_sizes = list_sizes
         self.list_data = list_data
         self.list_y2 = list_y2
+        # dequantization scale of an int8 scan cache (1.0 for float caches)
+        self.scan_scale = scan_scale
 
     @property
     def n_lists(self) -> int:
@@ -271,11 +284,18 @@ def _decode_lists(
     list_codes: np.ndarray,
     list_index: np.ndarray,
     dtype,
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, float]:
     """Host-side decode of packed lists → (list_data [L,cap,rot] dtype,
-    list_y2 [L,cap] f32). y = center_rot + concat_j codebook[j, code_j];
-    padding slots are zeroed. y2 is computed from the *stored* (rounded)
-    values so scores match what the scan kernel sees exactly."""
+    list_y2 [L,cap] f32, scan_scale). y = center_rot + concat_j
+    codebook[j, code_j]; padding slots are zeroed. y2 is computed from the
+    *stored* (rounded/quantized) values so scores match what the scan kernel
+    sees exactly.
+
+    ``dtype == int8`` selects the memory-lean scan cache (the TPU analog of
+    the reference's fp8 LUT accuracy class, ivf_pq_types.hpp lut_dtype):
+    reconstructions are symmetrically quantized with one global scale
+    (returned; 1.0 for float dtypes) and the scan runs on the MXU's native
+    int8 path — rot_dim bytes/vector, so DEEP-100M-shape datasets fit HBM."""
     L, cap, pq_dim = list_codes.shape
     codes = list_codes.astype(np.int64)
     if codebook_kind == CODEBOOK_PER_SUBSPACE:
@@ -286,10 +306,19 @@ def _decode_lists(
         dec = codebook[np.arange(L)[:, None, None], codes]
     y = dec.reshape(L, cap, -1) + centers_rot[:, None, :]
     y = np.where((list_index >= 0)[..., None], y, 0.0)
+    if dtype == jnp.int8:
+        scale = float(max(np.abs(y).max(), 1e-12)) / 127.0
+        y_int = np.clip(np.rint(y / scale), -127, 127).astype(np.int8)
+        y_f32 = y_int.astype(np.float32) * scale
+        return (
+            jnp.asarray(y_int),
+            jnp.asarray(np.sum(y_f32 * y_f32, axis=-1)),
+            scale,
+        )
     y_stored = jnp.asarray(y.astype(np.float32)).astype(dtype)
     y_f32 = y_stored.astype(jnp.float32)
     y2 = jnp.sum(y_f32 * y_f32, axis=-1)
-    return y_stored, y2
+    return y_stored, y2, 1.0
 
 
 def _pack_code_lists(
@@ -313,7 +342,7 @@ def _pack_code_lists(
     centers_rot = np.asarray(centers_rot)[center_map]
     if codebook_kind == CODEBOOK_PER_CLUSTER:
         codebook = np.asarray(codebook)[center_map]
-    list_data, list_y2 = _decode_lists(
+    list_data, list_y2, scan_scale = _decode_lists(
         codebook, codebook_kind, centers_rot, list_codes, list_index, dtype
     )
     return (
@@ -323,6 +352,7 @@ def _pack_code_lists(
         list_data,
         list_y2,
         center_map,
+        scan_scale,
     )
 
 
@@ -399,7 +429,8 @@ def build(
     else:
         raise ValueError(f"unknown codebook_kind {params.codebook_kind}")
 
-    dec_dtype = jnp.bfloat16 if params.decoded_dtype == "bfloat16" else jnp.float32
+    validation.check_in(params.decoded_dtype, _DECODED_DTYPES, "decoded_dtype")
+    dec_dtype = _DECODED_DTYPES[params.decoded_dtype]
     index = Index(
         params.metric,
         params.codebook_kind,
@@ -485,7 +516,10 @@ def extend(
         if index.codebook_kind == CODEBOOK_PER_CLUSTER
         else index.codebook
     )
-    list_codes, list_index, list_sizes, list_data, list_y2, cmap = _pack_code_lists(
+    (
+        list_codes, list_index, list_sizes, list_data, list_y2, cmap,
+        scan_scale,
+    ) = _pack_code_lists(
         all_codes, all_ids, all_labels, len(uniq),
         np.asarray(base_codebook), index.codebook_kind,
         np.asarray(base_centers_rot), index.list_data.dtype,
@@ -500,6 +534,7 @@ def extend(
         index.metric, index.codebook_kind, index.pq_bits,
         base_centers[cmap_j], base_centers_rot[cmap_j], index.rotation,
         codebook, list_codes, list_index, list_sizes, list_data, list_y2,
+        scan_scale,
     )
 
 
@@ -517,6 +552,7 @@ def _search_jit(
     list_y2,      # [L, cap] f32
     list_index,   # [L, cap] int32
     filter_words,
+    scan_scale,   # scalar f32 — int8-cache dequant scale (1.0 otherwise)
     n_probes: int,
     k: int,
     metric: str,
@@ -553,12 +589,27 @@ def _search_jit(
         # ip[t,p,c] = q_rot[t]·y[t,p,c] — batched over t, contracting rot
         # acc_dtype = the reference's internal_distance_dtype knob: the
         # score accumulator precision (ivf_pq_types.hpp:139-172)
-        ip = lax.dot_general(
-            qr.astype(scan_dtype),
-            dec.astype(scan_dtype),
-            (((1,), (3,)), ((0,), (0,))),                # contract rot; batch t
-            preferred_element_type=acc_dtype,
-        )                                                # [t, p, cap]
+        if list_data.dtype == jnp.int8:
+            # memory-lean mode: rows are int8 × global scan_scale; quantize
+            # the query per-row and ride the MXU's native int8 path, then
+            # rescale the int32 accumulator (the fp8-LUT accuracy analog)
+            sq = jnp.max(jnp.abs(qr), axis=1, keepdims=True) / 127.0
+            sq = jnp.maximum(sq, 1e-12)
+            q_i8 = jnp.clip(jnp.round(qr / sq), -127, 127).astype(jnp.int8)
+            ip_i32 = lax.dot_general(
+                q_i8,
+                dec,
+                (((1,), (3,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32,
+            )                                            # [t, p, cap]
+            ip = ip_i32.astype(jnp.float32) * (sq[:, :, None] * scan_scale)
+        else:
+            ip = lax.dot_general(
+                qr.astype(scan_dtype),
+                dec.astype(scan_dtype),
+                (((1,), (3,)), ((0,), (0,))),            # contract rot; batch t
+                preferred_element_type=acc_dtype,
+            )                                            # [t, p, cap]
         if metric == "inner_product":
             scores = (-ip).astype(jnp.float32)           # q·y == q_rot·y_rot
         else:
@@ -619,7 +670,10 @@ def search(
         jnp.bfloat16 if params.internal_distance_dtype == "bfloat16" else jnp.float32
     )
     # per-query workspace: probe gather of decoded rows + scores + ids
-    itemsize = 2 if scan_dtype == jnp.bfloat16 else 4
+    if index.list_data.dtype == jnp.int8:
+        itemsize = 1
+    else:
+        itemsize = 2 if scan_dtype == jnp.bfloat16 else 4
     per_q = n_probes * index.list_cap * (index.rot_dim * itemsize + 12)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=1024))))
     fw = sample_filter.words if sample_filter is not None else None
@@ -631,6 +685,7 @@ def search(
         index.list_y2,
         index.list_index,
         fw,
+        float(index.scan_scale),
         n_probes,
         int(k),
         canonical,
@@ -692,14 +747,13 @@ def load(filename: str) -> Index:
     cap, pq_dim = scalars["list_cap"], scalars["pq_dim"]
     codes = _unpack_bits(arrays["list_codes_packed"], pq_dim, scalars["pq_bits"])
     codes = codes.reshape(L, cap, pq_dim)
-    dec_dtype = (
-        jnp.bfloat16
-        if scalars.get("decoded_dtype", "bfloat16") == "bfloat16"
-        else jnp.float32
-    )
+    stored_dtype = scalars.get("decoded_dtype", "bfloat16")
+    validation.check_in(stored_dtype, _DECODED_DTYPES, "decoded_dtype")
+    dec_dtype = _DECODED_DTYPES[stored_dtype]
     list_index = arrays["list_index"]
-    # the decoded scan cache is derived state: rebuild it from the codes
-    list_data, list_y2 = _decode_lists(
+    # the decoded scan cache (and its int8 scale) is derived state: rebuild
+    # it from the codes
+    list_data, list_y2, scan_scale = _decode_lists(
         arrays["codebook"], scalars["codebook_kind"], arrays["centers_rot"],
         codes, list_index, dec_dtype,
     )
@@ -716,4 +770,5 @@ def load(filename: str) -> Index:
         jnp.asarray(arrays["list_sizes"]),
         list_data,
         list_y2,
+        scan_scale,
     )

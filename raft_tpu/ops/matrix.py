@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from raft_tpu.core.trace import traced
+from raft_tpu.core import validation
 
 
 def _min_identity(dtype):
@@ -114,6 +115,13 @@ def select_k(
         raise ValueError(f"k={k} larger than row length {n}")
 
     is_int = jnp.issubdtype(scores.dtype, jnp.integer)
+    if is_int and algo == "chunked":
+        # integer rows use the exact argsort path (top_k would need an
+        # unsafe negate/float promotion); refuse rather than silently
+        # ignore the explicit algorithm request
+        raise validation.LogicError(
+            "select_k algo='chunked' unsupported for integer dtypes"
+        )
     if not is_int and (
         algo == "chunked"
         or (algo == "auto" and n >= _CHUNKED_MIN_N and k <= _CHUNK)

@@ -164,3 +164,69 @@ def test_lut_bf16(built, data):
         ivf_pq.SearchParams(n_probes=50, lut_dtype="bfloat16"), built, q, 10
     )
     assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.85
+
+
+class TestInt8ScanCache:
+    """Memory-lean int8 scan cache (the fp8-LUT accuracy-class analog,
+    ref ivf_pq_types.hpp lut_dtype): rot_dim bytes/vector so DEEP-100M-shape
+    datasets fit HBM, scan on the MXU int8 path."""
+
+    @pytest.fixture(scope="class")
+    def built_i8(self, data):
+        x, _ = data
+        params = ivf_pq.IndexParams(
+            n_lists=50, kmeans_n_iters=10, pq_dim=32, pq_bits=8, seed=0,
+            decoded_dtype="int8",
+        )
+        return ivf_pq.build(params, x)
+
+    def test_storage_dtype_and_scale(self, built_i8):
+        assert built_i8.list_data.dtype == jnp.int8
+        assert built_i8.scan_scale > 0
+
+    def test_recall(self, built_i8, data):
+        x, q = data
+        k = 10
+        _, gt = brute_force.knn(x, q, k)
+        _, idx = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=50), built_i8, q, k
+        )
+        r = float(neighborhood_recall(np.asarray(idx), np.asarray(gt)))
+        assert r >= 0.80, r  # small int8 headroom vs the 0.85 float gate
+
+    def test_matches_float_scan_closely(self, built_i8, data):
+        """int8 quantization noise must not change the candidate set much:
+        ≥80% id overlap with the bf16-cache search at the same params."""
+        x, q = data
+        params = ivf_pq.IndexParams(
+            n_lists=50, kmeans_n_iters=10, pq_dim=32, pq_bits=8, seed=0
+        )
+        built_f = ivf_pq.build(params, x)
+        sp = ivf_pq.SearchParams(n_probes=50)
+        _, ia = ivf_pq.search(sp, built_i8, q, 10)
+        _, ib = ivf_pq.search(sp, built_f, q, 10)
+        ia, ib = np.asarray(ia), np.asarray(ib)
+        overlap = np.mean(
+            [len(set(ia[i]) & set(ib[i])) / 10 for i in range(len(ia))]
+        )
+        assert overlap >= 0.8, overlap
+
+    def test_save_load_roundtrip(self, built_i8, data, tmp_path):
+        x, q = data
+        f = str(tmp_path / "ivf_pq_i8.bin")
+        ivf_pq.save(f, built_i8)
+        loaded = ivf_pq.load(f)
+        assert loaded.list_data.dtype == jnp.int8
+        assert loaded.scan_scale == pytest.approx(built_i8.scan_scale)
+        sp = ivf_pq.SearchParams(n_probes=20)
+        da, ia = ivf_pq.search(sp, built_i8, q, 5)
+        db, ib = ivf_pq.search(sp, loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+        np.testing.assert_allclose(np.asarray(da), np.asarray(db), rtol=1e-5)
+
+    def test_extend_preserves_int8(self, built_i8, data):
+        x, _ = data
+        extra = x[:100] + 0.01
+        ext = ivf_pq.extend(built_i8, extra, jnp.arange(9000, 9100, dtype=jnp.int32))
+        assert ext.list_data.dtype == jnp.int8
+        assert ext.size == x.shape[0] + 100
