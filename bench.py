@@ -154,7 +154,7 @@ def main() -> None:
         return fn
 
     chosen = None
-    for n_probes in (8, 16, 32, 64, 128, 256):
+    for n_probes in (4, 6, 8, 16, 32, 64, 128, 256):
         if n_probes > params.n_lists:
             break
         fn = make_search(n_probes)

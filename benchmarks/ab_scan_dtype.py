@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""A/B the IVF-PQ scan-cache dtypes on one built index: bf16 vs f32 vs int8.
+
+The scan cache dtype is the TPU analog of the reference's lut_dtype accuracy
+ladder (ivf_pq_types.hpp:139-172 — fp32/fp16/fp8 LUTs). This measures, on
+the same index/codes, QPS and recall@k for each storage dtype so the default
+(`IndexParams.decoded_dtype`) is chosen from data, not guesswork
+(run on the real chip: `python benchmarks/ab_scan_dtype.py`).
+
+Output: one JSON line per (dtype, n_probes) operating point.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.core.resources import Resources
+    from raft_tpu.neighbors import brute_force, ivf_pq
+    from raft_tpu.neighbors.ivf_pq import _decode_lists
+
+    n, d, n_q, k = 100_000, 96, 10_000, 10
+    rng = np.random.default_rng(0)
+    n_blobs = 1024
+    bc = rng.standard_normal((n_blobs, d)).astype(np.float32)
+    asg = rng.integers(0, n_blobs, n)
+    dataset = jnp.asarray(
+        bc[asg] + rng.standard_normal((n, d)).astype(np.float32) * 0.35
+    )
+    qasg = rng.integers(0, n_blobs, n_q)
+    queries = jnp.asarray(
+        bc[qasg] + rng.standard_normal((n_q, d)).astype(np.float32) * 0.35
+    )
+    res = Resources(workspace_limit_bytes=1 << 30)
+
+    _, gt = brute_force.knn(dataset, queries, k, metric="sqeuclidean", res=res)
+    gt_ids = np.asarray(gt)
+
+    base = ivf_pq.build(
+        ivf_pq.IndexParams(
+            n_lists=1024, metric="sqeuclidean", pq_dim=d // 2, pq_bits=8,
+            kmeans_n_iters=10,
+        ),
+        dataset,
+        res=res,
+    )
+
+    def twin(dtype):
+        """Re-decode the same codes into a different scan-cache dtype."""
+        data, y2, scale = _decode_lists(
+            np.asarray(base.codebook), base.codebook_kind,
+            np.asarray(base.centers_rot), np.asarray(base.list_codes),
+            np.asarray(base.list_index), dtype,
+        )
+        return ivf_pq.Index(
+            base.metric, base.codebook_kind, base.pq_bits, base.centers,
+            base.centers_rot, base.rotation, base.codebook, base.list_codes,
+            base.list_index, base.list_sizes, data, y2, scale,
+        )
+
+    variants = {
+        "bfloat16": twin(jnp.bfloat16),
+        "float32": twin(jnp.float32),
+        "int8": twin(jnp.int8),
+    }
+
+    for name, index in variants.items():
+        for n_probes in (4, 8, 16, 32):
+            sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
+
+            def fn(q):
+                return ivf_pq.search(sp, index, q, k, res=res)
+
+            _, ids = fn(queries)  # warm + compile
+            jax.block_until_ready(ids)
+            t0 = time.perf_counter()
+            iters = 3
+            out = None
+            for _ in range(iters):
+                out = fn(queries)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+            ids_np = np.asarray(ids)
+            recall = np.mean(
+                [len(set(ids_np[i]) & set(gt_ids[i])) / k for i in range(n_q)]
+            )
+            print(
+                json.dumps(
+                    {
+                        "dtype": name,
+                        "n_probes": n_probes,
+                        "qps": round(n_q / dt, 1),
+                        "recall": round(float(recall), 4),
+                        "hbm_bytes_per_vec": int(
+                            index.list_data.dtype.itemsize * index.rot_dim
+                        ),
+                    }
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -14,10 +14,9 @@
 #include <vector>
 
 #include "raft_tpu/core/error.hpp"
+#include "raft_tpu/core/memory_type.hpp"
 
 namespace raft_tpu {
-
-enum class memory_type : int { host = 0, pinned = 1, device = 2, managed = 3 };
 
 enum class dtype : int {
   f32 = 0,

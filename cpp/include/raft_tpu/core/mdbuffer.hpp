@@ -1,0 +1,95 @@
+// Owns-or-views buffer for host-generic native APIs.
+//
+// Analog of the reference's raft::mdbuffer (core/mdbuffer.cuh:241-396): a
+// runtime-variant container that either owns an mdarray or views caller
+// memory, letting one native entry point accept both without copies —
+// copying only when the requested memory space differs. The device space on
+// TPU is XLA-owned, so the native variant covers the host/pinned staging
+// spaces the runtime actually manages.
+#pragma once
+
+#include <cstring>
+#include <utility>
+#include <vector>
+
+#include "raft_tpu/core/error.hpp"
+#include "raft_tpu/core/mdarray.hpp"
+#include "raft_tpu/core/memory_type.hpp"
+#include "raft_tpu/core/span.hpp"
+
+namespace raft_tpu {
+
+class mdbuffer {
+ public:
+  mdbuffer() = default;
+
+  // owning: adopt an mdarray
+  explicit mdbuffer(mdarray&& owned)
+      : owned_(std::move(owned)), owning_(true) {}
+
+  // viewing: borrow caller memory (caller keeps it alive)
+  mdbuffer(void* data, std::vector<std::int64_t> shape, dtype dt,
+           memory_type mem = memory_type::host)
+      : view_data_(data),
+        view_shape_(std::move(shape)),
+        view_dtype_(dt),
+        view_mem_(mem),
+        owning_(false) {
+    RAFT_TPU_EXPECTS(data != nullptr, "mdbuffer view of null data");
+  }
+
+  bool is_owning() const { return owning_; }
+
+  const std::vector<std::int64_t>& shape() const {
+    return owning_ ? owned_.shape() : view_shape_;
+  }
+  dtype type() const { return owning_ ? owned_.type() : view_dtype_; }
+  memory_type mem() const { return owning_ ? owned_.mem() : view_mem_; }
+
+  std::int64_t size() const {
+    std::int64_t n = 1;
+    for (auto e : shape()) n *= e;
+    return n;
+  }
+  std::size_t size_bytes() const {
+    return static_cast<std::size_t>(size()) * dtype_size(type());
+  }
+
+  void* data() { return owning_ ? owned_.data() : view_data_; }
+  const void* data() const { return owning_ ? owned_.data() : view_data_; }
+
+  template <typename T>
+  span<T> view() {
+    RAFT_TPU_EXPECTS(is_host_accessible(mem()),
+                     "mdbuffer::view on non-host memory");
+    RAFT_TPU_EXPECTS(sizeof(T) == dtype_size(type()),
+                     "mdbuffer::view element size mismatch");
+    return span<T>(reinterpret_cast<T*>(data()),
+                   static_cast<std::size_t>(size()));
+  }
+
+  // Return a buffer guaranteed to live in `target` space: this one when it
+  // already matches (no copy — the mdbuffer promise), else an owning copy.
+  mdbuffer ensure(memory_type target) && {
+    if (mem() == target) return std::move(*this);
+    auto native_space = [](memory_type t) {
+      return t == memory_type::host || t == memory_type::pinned;
+    };
+    RAFT_TPU_EXPECTS(
+        native_space(mem()) && native_space(target),
+        "native mdbuffer moves between host/pinned spaces only");
+    mdarray copy(shape(), type(), target);
+    std::memcpy(copy.data(), data(), size_bytes());
+    return mdbuffer(std::move(copy));
+  }
+
+ private:
+  mdarray owned_;
+  void* view_data_ = nullptr;
+  std::vector<std::int64_t> view_shape_;
+  dtype view_dtype_ = dtype::f32;
+  memory_type view_mem_ = memory_type::host;
+  bool owning_ = false;
+};
+
+}  // namespace raft_tpu

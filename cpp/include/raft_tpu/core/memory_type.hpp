@@ -1,0 +1,29 @@
+// Memory-space tagging for the native runtime.
+//
+// Analog of the reference's memory_type enum + accessibility predicates
+// (core/memory_type.hpp:30-56). The TPU runtime's device space is XLA/PJRT
+// HBM; host/pinned are the native-core staging spaces used by mdarray /
+// mdbuffer / the .npy serializer.
+#pragma once
+
+namespace raft_tpu {
+
+enum class memory_type : int { host = 0, pinned = 1, device = 2, managed = 3 };
+
+// Is memory of this type directly dereferenceable from host code?
+constexpr bool is_host_accessible(memory_type t) {
+  return t == memory_type::host || t == memory_type::pinned ||
+         t == memory_type::managed;
+}
+
+// Is memory of this type addressable by the accelerator?
+constexpr bool is_device_accessible(memory_type t) {
+  return t == memory_type::pinned || t == memory_type::device ||
+         t == memory_type::managed;
+}
+
+constexpr bool is_host_device_accessible(memory_type t) {
+  return is_host_accessible(t) && is_device_accessible(t);
+}
+
+}  // namespace raft_tpu

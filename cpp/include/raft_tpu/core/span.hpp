@@ -1,0 +1,76 @@
+// Non-owning typed view over contiguous memory.
+//
+// Analog of the reference's raft::span / device_span (core/span.hpp,
+// core/device_span.hpp): a std::span-style view carrying a memory_type tag
+// so host code cannot silently dereference device memory. C++17 (no
+// std::span dependency), bounds-checked via RAFT_TPU_EXPECTS.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "raft_tpu/core/error.hpp"
+#include "raft_tpu/core/memory_type.hpp"
+
+namespace raft_tpu {
+
+inline constexpr std::size_t dynamic_extent = static_cast<std::size_t>(-1);
+
+template <typename T, memory_type Mem = memory_type::host>
+class span {
+ public:
+  using element_type = T;
+
+  constexpr span() : data_(nullptr), size_(0) {}
+  constexpr span(T* data, std::size_t size) : data_(data), size_(size) {}
+
+  constexpr T* data() const { return data_; }
+  constexpr std::size_t size() const { return size_; }
+  constexpr std::size_t size_bytes() const { return size_ * sizeof(T); }
+  constexpr bool empty() const { return size_ == 0; }
+  static constexpr memory_type mem() { return Mem; }
+
+  T& operator[](std::size_t i) const {
+    static_assert(is_host_accessible(Mem),
+                  "indexing requires host-accessible memory");
+    return data_[i];
+  }
+
+  T& at(std::size_t i) const {
+    static_assert(is_host_accessible(Mem),
+                  "indexing requires host-accessible memory");
+    RAFT_TPU_EXPECTS(i < size_, "span index out of range");
+    return data_[i];
+  }
+
+  constexpr T* begin() const { return data_; }
+  constexpr T* end() const { return data_ + size_; }
+
+  span subspan(std::size_t offset, std::size_t count = dynamic_extent) const {
+    RAFT_TPU_EXPECTS(offset <= size_, "subspan offset out of range");
+    std::size_t n = count == dynamic_extent ? size_ - offset : count;
+    RAFT_TPU_EXPECTS(offset + n <= size_, "subspan extent out of range");
+    return span(data_ + offset, n);
+  }
+
+  span<T const, Mem> as_const() const {
+    return span<T const, Mem>(data_, size_);
+  }
+
+ private:
+  T* data_;
+  std::size_t size_;
+};
+
+template <typename T>
+using host_span = span<T, memory_type::host>;
+
+template <typename T>
+using device_span = span<T, memory_type::device>;
+
+template <typename T>
+span<T> make_span(T* data, std::size_t size) {
+  return span<T>(data, size);
+}
+
+}  // namespace raft_tpu

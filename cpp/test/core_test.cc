@@ -1,0 +1,108 @@
+// Native-core unit tests: span / memory_type / mdarray / mdbuffer.
+// (ref: the reference's cpp/test/core/ gtest suites — here a dependency-free
+// assert runner invoked by tests/test_native.py via `make check-core`.)
+#include <cassert>
+#include <cstring>
+#include <iostream>
+#include <vector>
+
+#include "raft_tpu/core/mdbuffer.hpp"
+#include "raft_tpu/core/memory_type.hpp"
+#include "raft_tpu/core/span.hpp"
+
+using namespace raft_tpu;
+
+static int failures = 0;
+#define CHECK(cond)                                          \
+  do {                                                       \
+    if (!(cond)) {                                           \
+      std::cerr << "FAIL " << __LINE__ << ": " #cond "\n";   \
+      ++failures;                                            \
+    }                                                        \
+  } while (0)
+
+static void test_memory_type() {
+  static_assert(is_host_accessible(memory_type::host), "");
+  static_assert(is_host_accessible(memory_type::pinned), "");
+  static_assert(!is_host_accessible(memory_type::device), "");
+  static_assert(is_device_accessible(memory_type::device), "");
+  static_assert(!is_device_accessible(memory_type::host), "");
+  static_assert(is_host_device_accessible(memory_type::managed), "");
+}
+
+static void test_span() {
+  int data[5] = {1, 2, 3, 4, 5};
+  auto s = make_span(data, 5);
+  CHECK(s.size() == 5 && s.size_bytes() == 5 * sizeof(int));
+  CHECK(s[0] == 1 && s.at(4) == 5);
+  auto sub = s.subspan(1, 3);
+  CHECK(sub.size() == 3 && sub[0] == 2 && sub[2] == 4);
+  CHECK(s.subspan(2).size() == 3);
+  bool threw = false;
+  try {
+    s.at(5);
+  } catch (const raft_tpu::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  threw = false;
+  try {
+    s.subspan(3, 4);
+  } catch (const raft_tpu::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  int total = 0;
+  for (int v : s) total += v;
+  CHECK(total == 15);
+}
+
+static void test_mdbuffer() {
+  // viewing: no copy, mutations visible to the caller
+  std::vector<float> host(12, 1.0f);
+  mdbuffer view(host.data(), {3, 4}, dtype::f32);
+  CHECK(!view.is_owning());
+  CHECK(view.size() == 12 && view.size_bytes() == 48);
+  view.view<float>()[3] = 7.0f;
+  CHECK(host[3] == 7.0f);
+
+  // ensure(same space) keeps the view (no copy)
+  mdbuffer same = std::move(view).ensure(memory_type::host);
+  CHECK(!same.is_owning());
+  CHECK(same.data() == host.data());
+
+  // ensure(other space) copies into an owning buffer
+  mdbuffer pinned = std::move(same).ensure(memory_type::pinned);
+  CHECK(pinned.is_owning());
+  CHECK(pinned.mem() == memory_type::pinned);
+  CHECK(pinned.data() != host.data());
+  CHECK(pinned.view<float>()[3] == 7.0f);
+
+  // owning adoption of an mdarray
+  mdarray arr({2, 2}, dtype::i32);
+  arr.data_as<int>()[0] = 42;
+  mdbuffer owned(std::move(arr));
+  CHECK(owned.is_owning());
+  CHECK(owned.view<int>()[0] == 42);
+
+  // element-size mismatch guard
+  bool threw = false;
+  try {
+    owned.view<double>();
+  } catch (const raft_tpu::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+int main() {
+  test_memory_type();
+  test_span();
+  test_mdbuffer();
+  if (failures) {
+    std::cerr << failures << " failures\n";
+    return 1;
+  }
+  std::cout << "core_test ok\n";
+  return 0;
+}

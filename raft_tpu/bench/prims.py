@@ -58,7 +58,9 @@ def _cases() -> List[Dict]:
     # closed-over array becomes an XLA constant and the whole benchmark gets
     # constant-folded at compile time.
 
-    # select_k (ref: bench/prims/matrix/select_k.cu shapes)
+    # select_k (ref: bench/prims/matrix/select_k.cu shapes); the explicit
+    # algo cases A/B the wide-top_k vs chunked-tournament paths to tune the
+    # auto heuristic (_CHUNKED_MIN_N — the select_k-inl.cuh:47 analog)
     for rows, cols, k in [(1024, 16384, 64), (128, 131072, 256), (4096, 2048, 10)]:
         x = jnp.asarray(rng.standard_normal((rows, cols)).astype(np.float32))
         fn = jax.jit(functools.partial(select_k, k=k, select_min=True))
@@ -71,6 +73,24 @@ def _cases() -> List[Dict]:
                 "flops": 0,
             }
         )
+    for rows, cols, k in [
+        (1024, 16384, 64), (128, 131072, 256), (64, 1_000_000, 100),
+        (4096, 8192, 16),
+    ]:
+        x = jnp.asarray(rng.standard_normal((rows, cols)).astype(np.float32))
+        for algo in ("topk", "chunked"):
+            fn = jax.jit(
+                functools.partial(select_k, k=k, select_min=True, algo=algo)
+            )
+            cases.append(
+                {
+                    "name": f"select_k_ab/{rows}x{cols}/k{k}/{algo}",
+                    "fn": fn,
+                    "args": (x,),
+                    "bytes": rows * cols * 4,
+                    "flops": 0,
+                }
+            )
 
     # pairwise distance (ref: bench/prims/distance/)
     for m, n, d, metric in [(2048, 2048, 128, "sqeuclidean"), (1024, 1024, 512, "l1")]:

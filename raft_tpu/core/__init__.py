@@ -13,6 +13,7 @@ from raft_tpu.core.resources import (
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core import serialize
 from raft_tpu.core.validation import RaftError, LogicError, expects, fail
+from raft_tpu.core.fanout import async_fanout, prefetch_to_device, row_batches
 
 __all__ = [
     "Resources",
@@ -25,4 +26,7 @@ __all__ = [
     "LogicError",
     "expects",
     "fail",
+    "async_fanout",
+    "prefetch_to_device",
+    "row_batches",
 ]

@@ -1,0 +1,57 @@
+"""Async batch fan-out — the stream-pool parallelism analog.
+
+The reference overlaps independent work by fanning batches across a CUDA
+stream pool (core/resource/cuda_stream_pool.hpp; brute-force kNN fan-out
+neighbors/detail/knn_brute_force.cuh:451-485). XLA's execution model gives
+the same overlap through *async dispatch*: every jitted call returns
+immediately with futures, and the runtime pipelines consecutive executions
+(compute of call i overlaps host work and transfers of call i+1). These
+helpers make that idiom a first-class component: dispatch everything, block
+once.
+
+Why not one giant program? A single fused program is usually best on TPU —
+use these when batches are genuinely independent units (different shapes,
+incremental arrival, per-batch host post-processing) where the reference
+would have used the stream pool.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def async_fanout(fn: Callable, arg_tuples: Sequence[Tuple]) -> List:
+    """Dispatch ``fn(*args)`` for every tuple without blocking between
+    calls, then block once on everything — all executions are in flight
+    together, back-to-back on device (the stream-pool fan-out shape).
+    """
+    outs = [fn(*args) for args in arg_tuples]
+    jax.block_until_ready(outs)
+    return outs
+
+
+def row_batches(x, batch_rows: int) -> Iterator:
+    """Slice a [n, ...] array into row batches of at most ``batch_rows``."""
+    n = x.shape[0]
+    for s in range(0, n, batch_rows):
+        yield x[s : min(s + batch_rows, n)]
+
+
+def prefetch_to_device(chunks: Iterable, lookahead: int = 2) -> Iterator:
+    """Double-buffered host→device pipeline: keep ``lookahead`` chunks'
+    transfers in flight ahead of the consumer (the H2D/compute overlap the
+    reference gets from pinned-memory async copies on a side stream).
+    """
+    import collections
+
+    queue: collections.deque = collections.deque()
+    it = iter(chunks)
+    for chunk in it:
+        queue.append(jax.device_put(chunk))
+        if len(queue) > lookahead:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

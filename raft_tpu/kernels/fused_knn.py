@@ -33,11 +33,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.kernels.toolkit import col_ids_tile, fold_topk
+
 _WORST = float("inf")
 
 
 def _fused_knn_kernel(q_ref, x_ref, xx_ref, vals_ref, idx_ref, *, k: int,
-                      tile_n: int, n_total: int, k_pad: int):
+                      tile_n: int):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -54,25 +56,12 @@ def _fused_knn_kernel(q_ref, x_ref, xx_ref, vals_ref, idx_ref, *, k: int,
     )
     scores = xx_ref[0, :][None, :] - 2.0 * dots  # xx = +inf on padded rows
 
-    col_base = j * tile_n
-    col_ids = col_base + jax.lax.broadcasted_iota(jnp.int32, (qt, tile_n), 1)
-
-    cand_v = jnp.concatenate([vals_ref[:], scores], axis=1)
-    cand_i = jnp.concatenate([idx_ref[:], col_ids], axis=1)
-    n_cand = k_pad + tile_n
-    pos = jax.lax.broadcasted_iota(jnp.int32, (qt, n_cand), 1)
-
-    def extract(t, cv):
-        m = jnp.min(cv, axis=1)
-        first = jnp.min(jnp.where(cv == m[:, None], pos, n_cand), axis=1)
-        onehot = pos == first[:, None]
-        vals_ref[:, pl.ds(t, 1)] = m[:, None]
-        idx_ref[:, pl.ds(t, 1)] = jnp.sum(
-            jnp.where(onehot, cand_i, 0), axis=1, keepdims=True
-        )
-        return jnp.where(onehot, _WORST, cv)
-
-    jax.lax.fori_loop(0, k, extract, cand_v)
+    col_ids = col_ids_tile(qt, tile_n, j * tile_n)
+    # fold the fresh tile into the VMEM-resident queue (toolkit.fold_topk —
+    # the warpsort-queue analog)
+    vals, idx = fold_topk(vals_ref[:], idx_ref[:], scores, col_ids, k)
+    vals_ref[:] = vals
+    idx_ref[:] = idx
 
 
 @functools.partial(
@@ -122,9 +111,7 @@ def fused_l2_topk(
     xx = xx[None, :]
 
     grid = ((n_q + q_pad) // tile_q, (n + n_pad) // tile_n)
-    kernel = functools.partial(
-        _fused_knn_kernel, k=k, tile_n=tile_n, n_total=n, k_pad=k_pad
-    )
+    kernel = functools.partial(_fused_knn_kernel, k=k, tile_n=tile_n)
     vals, idx = pl.pallas_call(
         kernel,
         grid=grid,

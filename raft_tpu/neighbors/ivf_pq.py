@@ -456,6 +456,100 @@ def build(
     return index
 
 
+def _decode_rows(index: Index, codes: jax.Array, labels: jax.Array):
+    """Decode encoded rows → (stored-dtype rows [n, rot_dim], y2 [n]) using
+    the index's scan-cache dtype (+frozen int8 scale). Device-side; the
+    per-row analog of the host _decode_lists pass."""
+    pq_dim = index.pq_dim
+    codes_i = codes.astype(jnp.int32)
+    if index.codebook_kind == CODEBOOK_PER_SUBSPACE:
+        dec = jnp.take_along_axis(
+            index.codebook[None],  # [1, j, K, l]
+            codes_i[:, :, None, None],  # [n, j, 1, 1]
+            axis=2,
+        )[:, :, 0, :]  # [n, j, l]
+    else:
+        cb = index.codebook[labels]  # [n, K, l] per-cluster books
+        dec = jnp.take_along_axis(cb, codes_i[:, :, None], axis=1)
+    y = dec.reshape(codes.shape[0], -1) + index.centers_rot[labels]
+    if index.list_data.dtype == jnp.int8:
+        y_int = jnp.clip(
+            jnp.round(y / index.scan_scale), -127, 127
+        ).astype(jnp.int8)
+        y_f32 = y_int.astype(jnp.float32) * index.scan_scale
+        return y_int, jnp.sum(y_f32 * y_f32, axis=-1)
+    y_stored = y.astype(index.list_data.dtype)
+    y_f32 = y_stored.astype(jnp.float32)
+    return y_stored, jnp.sum(y_f32 * y_f32, axis=-1)
+
+
+def _extend_fast(index: Index, codes_np, labels_np, new_ids):
+    """In-place append when the target lists still have spare capacity:
+    scatter the new rows' codes/ids/decoded-values into the existing padded
+    layout (device .at[] scatters for the scan cache — HBM-bandwidth cost,
+    not a host re-decode of the whole index; the TPU answer to the
+    reference's device-side list growth, ivf_pq_build.cuh:1501).
+
+    Split shards of a skewed list share one centroid; rows whose predicted
+    shard is full overflow into a sibling shard with space (they score
+    identically at probe selection, see _common.split_oversized_lists).
+    Returns None when a centroid group is out of capacity altogether
+    (caller falls back to the full repack+re-split path)."""
+    L, cap = index.n_lists, index.list_cap
+    sizes = np.asarray(index.list_sizes).copy()
+    labels_np = np.asarray(labels_np, np.int64)
+    if labels_np.size and labels_np.max() >= L:
+        return None
+
+    # centroid-identity groups (split shards duplicate their parent row)
+    centers_np = np.asarray(index.centers)
+    _, inverse = np.unique(centers_np, axis=0, return_inverse=True)
+    group_members = {}
+    for lst, g in enumerate(inverse):
+        group_members.setdefault(int(g), []).append(lst)
+
+    slab = np.empty_like(labels_np)
+    slots = np.empty_like(labels_np)
+    for g in np.unique(inverse[labels_np]):
+        rows = np.nonzero(inverse[labels_np] == g)[0]
+        members = group_members[int(g)]
+        if sum(cap - sizes[m] for m in members) < len(rows):
+            return None  # group out of capacity → full repack
+        i = 0
+        for m in members:
+            spare = cap - sizes[m]
+            take = min(spare, len(rows) - i)
+            if take <= 0:
+                continue
+            sel = rows[i : i + take]
+            slab[sel] = m
+            slots[sel] = sizes[m] + np.arange(take)
+            sizes[m] += take
+            i += take
+            if i == len(rows):
+                break
+    counts_new = sizes - np.asarray(index.list_sizes)
+
+    lj = jnp.asarray(slab)
+    sj = jnp.asarray(slots)
+    ids_j = jnp.asarray(np.asarray(new_ids, np.int32))
+
+    dec_rows, y2_rows = _decode_rows(index, jnp.asarray(codes_np), lj)
+
+    list_codes = np.array(index.list_codes, copy=True)
+    list_codes[slab, slots] = codes_np
+    return Index(
+        index.metric, index.codebook_kind, index.pq_bits,
+        index.centers, index.centers_rot, index.rotation, index.codebook,
+        list_codes,
+        index.list_index.at[lj, sj].set(ids_j),
+        index.list_sizes + jnp.asarray(counts_new, jnp.int32),
+        index.list_data.at[lj, sj].set(dec_rows),
+        index.list_y2.at[lj, sj].set(y2_rows),
+        index.scan_scale,
+    )
+
+
 @traced("ivf_pq.extend")
 def extend(
     index: Index,
@@ -498,6 +592,12 @@ def extend(
     old_n = index.size
     if new_indices is None:
         new_indices = jnp.arange(old_n, old_n + n, dtype=jnp.int32)
+
+    # fast path: append into spare capacity without touching existing rows
+    if n and old_n:
+        fast = _extend_fast(index, codes, labels, np.asarray(new_indices))
+        if fast is not None:
+            return fast
 
     old_codes, old_ids, old_labels = unpack_lists(
         np.asarray(index.list_codes), np.asarray(index.list_index)

@@ -168,3 +168,29 @@ class TestValidation:
             brute_force.knn(x, rng.random((2, 5)).astype(np.float32), 3)
         with pytest.raises(v.LogicError):
             brute_force.knn(x, x, k=11)
+
+
+class TestFanout:
+    """Stream-pool analog: async dispatch fan-out + H2D prefetch
+    (ref: core/resource/cuda_stream_pool.hpp; knn_brute_force.cuh:451-485)."""
+
+    def test_async_fanout_matches_sequential(self, rng):
+        from raft_tpu.core.fanout import async_fanout, row_batches
+
+        f = jax.jit(lambda a: jnp.sum(a * a, axis=1))
+        x = rng.random((1000, 16)).astype(np.float32)
+        batches = [(b,) for b in row_batches(jnp.asarray(x), 256)]
+        assert [b[0].shape[0] for b in batches] == [256, 256, 256, 232]
+        outs = async_fanout(f, batches)
+        got = np.concatenate([np.asarray(o) for o in outs])
+        np.testing.assert_allclose(got, (x * x).sum(1), rtol=1e-5)
+
+    def test_prefetch_to_device(self, rng):
+        from raft_tpu.core.fanout import prefetch_to_device
+
+        chunks = [rng.random((8, 4)).astype(np.float32) for _ in range(5)]
+        out = list(prefetch_to_device(chunks, lookahead=2))
+        assert len(out) == 5
+        for c, o in zip(chunks, out):
+            assert isinstance(o, jax.Array)
+            np.testing.assert_array_equal(np.asarray(o), c)

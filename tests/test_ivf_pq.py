@@ -230,3 +230,52 @@ class TestInt8ScanCache:
         ext = ivf_pq.extend(built_i8, extra, jnp.arange(9000, 9100, dtype=jnp.int32))
         assert ext.list_data.dtype == jnp.int8
         assert ext.size == x.shape[0] + 100
+
+
+class TestExtendFastPath:
+    """Device-side fast append (ref: device-side list growth,
+    ivf_pq_build.cuh:1501): when new rows fit existing spare capacity the
+    index must NOT be repacked — and results must match the repack path."""
+
+    def _mk(self, n=4000, d=32, seed=0):
+        key = jax.random.PRNGKey(seed)
+        x, _, _ = make_blobs(key, n, d, n_clusters=16, cluster_std=2.0)
+        # shuffle so a row-suffix spans all clusters (make_blobs orders rows
+        # by cluster; an unshuffled suffix would overflow one single list)
+        perm = np.random.default_rng(seed).permutation(n)
+        return np.asarray(x)[perm]
+
+    def test_fast_path_taken_and_correct(self, monkeypatch):
+        x = self._mk()
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        index = ivf_pq.build(params, x[:3800])
+        extra, ids = x[3800:], jnp.arange(3800, 4000, dtype=jnp.int32)
+
+        fast = ivf_pq.extend(index, extra, ids)
+        # capacity spare → fast path keeps the packed layout objects' shape
+        assert fast.list_cap == index.list_cap
+        assert fast.n_lists == index.n_lists
+        assert fast.size == 4000
+
+        # force the slow repack path and compare search results
+        monkeypatch.setattr(ivf_pq, "_extend_fast", lambda *a, **k: None)
+        slow = ivf_pq.extend(index, extra, ids)
+        assert slow.size == 4000
+        q = x[:64]
+        sp = ivf_pq.SearchParams(n_probes=16)
+        _, fi = ivf_pq.search(sp, fast, q, 10)
+        _, si = ivf_pq.search(sp, slow, q, 10)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(fi), axis=1), np.sort(np.asarray(si), axis=1)
+        )
+
+    def test_overflow_falls_back(self):
+        x = self._mk()
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        index = ivf_pq.build(params, x[:2000])
+        # doubling the data must overflow some list and trigger repack
+        ext = ivf_pq.extend(index, x[2000:], jnp.arange(2000, 4000, dtype=jnp.int32))
+        assert ext.size == 4000
+        # every id present exactly once
+        ids = np.asarray(ext.list_index)
+        np.testing.assert_array_equal(np.sort(ids[ids >= 0]), np.arange(4000))

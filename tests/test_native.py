@@ -140,3 +140,21 @@ def test_header_compile_surface():
         timeout=120,
     )
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_native_core_units():
+    """span / memory_type / mdarray / mdbuffer behavioral tests (ref:
+    cpp/test/core/ gtest suites) via the dependency-free assert runner."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    cpp = os.path.join(os.path.dirname(os.path.dirname(__file__)), "cpp")
+    out = subprocess.run(
+        ["make", "-C", cpp, "check-core"], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "core_test ok" in out.stdout

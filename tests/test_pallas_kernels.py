@@ -56,3 +56,67 @@ def test_fused_l2_argmin_matches_exact(rng):
     want_scores = d2[np.arange(n), want]
     np.testing.assert_allclose(got_scores, want_scores, rtol=1e-4, atol=1e-4)
     assert (np.asarray(idx) == want).mean() > 0.999  # ties are measure-zero
+
+
+class TestToolkit:
+    """Kernel toolkit building blocks (ref: cpp/include/raft/util/ +
+    linalg/contractions.cuh tiling policies)."""
+
+    def test_address_math(self):
+        from raft_tpu.kernels import toolkit as tk
+
+        assert tk.cdiv(10, 3) == 4
+        assert tk.round_up(100, 128) == 128
+        assert tk.next_pow2(100) == 128 and tk.next_pow2(1) == 1
+        x = jnp.ones((5, 7))
+        p = tk.pad_dim(x, 1, 8, fill=-1.0)
+        assert p.shape == (5, 8) and float(p[0, 7]) == -1.0
+        assert tk.pad_dim(x, 0, 5) is x
+
+    def test_tile_policy_fits_budget(self):
+        from raft_tpu.kernels import toolkit as tk
+
+        pol = tk.choose_tile_policy(10_000, 1_000_000, 96, extra_cols=128)
+        assert pol.vmem_bytes <= 8 * 1024 * 1024
+        assert pol.tile_m % tk.SUBLANE == 0 and pol.tile_n % tk.LANE == 0
+        assert pol.grid[0] * pol.tile_m >= 10_000
+        assert pol.grid[1] * pol.tile_n >= 1_000_000
+        small = tk.choose_tile_policy(16, 100, 8)
+        assert small.tile_m <= 512 and small.grid == (1, 1)
+
+    def test_fold_topk_matches_sort(self, rng):
+        from raft_tpu.kernels import toolkit as tk
+
+        rows, k_pad, c, k = 6, 32, 100, 9
+        run_v = jnp.full((rows, k_pad), float("inf"))
+        run_i = jnp.zeros((rows, k_pad), jnp.int32)
+        a = rng.standard_normal((rows, c)).astype(np.float32)
+        ia = jnp.asarray(rng.integers(0, 10_000, (rows, c)).astype(np.int32))
+        v1, i1 = tk.fold_topk(run_v, run_i, jnp.asarray(a), ia, k)
+        # second fold with more candidates must equal top-k of the union
+        b = rng.standard_normal((rows, c)).astype(np.float32)
+        ib = jnp.asarray(rng.integers(10_000, 20_000, (rows, c)).astype(np.int32))
+        v2, i2 = tk.fold_topk(v1, i1, jnp.asarray(b), ib, k)
+        union = np.concatenate([a, b], axis=1)
+        union_i = np.concatenate([np.asarray(ia), np.asarray(ib)], axis=1)
+        order = np.argsort(union, axis=1)[:, :k]
+        np.testing.assert_allclose(
+            np.asarray(v2)[:, :k], np.take_along_axis(union, order, 1), rtol=1e-6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(i2)[:, :k], np.take_along_axis(union_i, order, 1)
+        )
+        # slots past k hold the worst sentinel
+        assert np.isinf(np.asarray(v2)[:, k:]).all()
+
+
+def test_tile_policy_alignment_under_pressure():
+    """Shrinking under a tight VMEM budget must keep native alignment
+    (regression: halving a non-power-of-two start left off-quantum tiles)."""
+    from raft_tpu.kernels import toolkit as tk
+
+    p1 = tk.choose_tile_policy(16, 640, 8192)
+    assert p1.tile_n % tk.LANE == 0 and p1.tile_m % tk.SUBLANE == 0
+    p2 = tk.choose_tile_policy(40, 100_000, 4096, vmem_budget=2 * 1024 * 1024)
+    assert p2.tile_m % tk.SUBLANE == 0 and p2.tile_m >= tk.SUBLANE
+    assert p2.tile_n % tk.LANE == 0 and p2.tile_n >= tk.LANE
