@@ -185,3 +185,50 @@ def invalid_mask(ids: jax.Array, filter_words: Optional[jax.Array]) -> jax.Array
         bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
         invalid = invalid | (bit == 0)
     return invalid
+
+
+def allocate_append_slots(centers, list_sizes, cap, labels):
+    """Assign a (list, slot) to each new row for an in-place append, or
+    return None when a centroid group is out of spare capacity.
+
+    Split shards of a skewed list duplicate their parent centroid (see
+    split_oversized_lists); rows whose predicted shard is full overflow
+    into a sibling shard with space — they rank identically at probe
+    selection, so placement among siblings is recall-neutral. Shared by the
+    IVF-Flat/IVF-PQ fast extend paths (the TPU answer to the reference's
+    device-side list growth, ivf_flat_build.cuh:163 / ivf_pq_build.cuh:1501).
+
+    Returns (lists [n], slots [n], counts_new [L]) — all numpy — or None.
+    """
+    centers = np.asarray(centers)
+    sizes = np.asarray(list_sizes).copy()
+    labels = np.asarray(labels, np.int64)
+    L = centers.shape[0]
+    if labels.size and labels.max() >= L:
+        return None
+
+    _, inverse = np.unique(centers, axis=0, return_inverse=True)
+    group_members: dict = {}
+    for lst, g in enumerate(inverse):
+        group_members.setdefault(int(g), []).append(lst)
+
+    out_list = np.empty_like(labels)
+    out_slot = np.empty_like(labels)
+    for g in np.unique(inverse[labels]):
+        rows = np.nonzero(inverse[labels] == g)[0]
+        members = group_members[int(g)]
+        if sum(cap - sizes[m] for m in members) < len(rows):
+            return None  # group out of capacity → caller repacks
+        i = 0
+        for m in members:
+            take = min(cap - sizes[m], len(rows) - i)
+            if take <= 0:
+                continue
+            sel = rows[i : i + take]
+            out_list[sel] = m
+            out_slot[sel] = sizes[m] + np.arange(take)
+            sizes[m] += take
+            i += take
+            if i == len(rows):
+                break
+    return out_list, out_slot, sizes - np.asarray(list_sizes)

@@ -40,6 +40,7 @@ from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
+    allocate_append_slots,
     coarse_select,
     invalid_mask,
     default_max_cap,
@@ -211,6 +212,30 @@ def extend(
     old_n = index.size
     if new_indices is None:
         new_indices = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
+
+    # fast path: append into spare capacity with device scatters, no repack
+    # (the TPU answer to the reference's device-side list growth,
+    # detail/ivf_flat_build.cuh:163; shard-aware — see allocate_append_slots)
+    if new_vectors.shape[0] and old_n:
+        alloc = allocate_append_slots(
+            index.centers, index.list_sizes, index.list_cap, np.asarray(labels)
+        )
+        if alloc is not None:
+            slab, slots, counts_new = alloc
+            lj, sj = jnp.asarray(slab), jnp.asarray(slots)
+            rows32 = new_vectors.astype(jnp.float32)
+            return Index(
+                index.metric,
+                index.centers,
+                index.list_data.at[lj, sj].set(new_vectors),
+                index.list_index.at[lj, sj].set(
+                    jnp.asarray(new_indices, jnp.int32)
+                ),
+                index.list_sizes + jnp.asarray(counts_new, jnp.int32),
+                index.list_norms.at[lj, sj].set(
+                    jnp.sum(rows32 * rows32, axis=-1)
+                ),
+            )
 
     # merge with existing content host-side, then re-pack; split shards from
     # a previous pack are first merged back to their parent list so repeated

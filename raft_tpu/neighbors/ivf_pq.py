@@ -59,6 +59,7 @@ from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
+    allocate_append_slots,
     coarse_select,
     default_max_cap,
     invalid_mask,
@@ -495,40 +496,12 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
     identically at probe selection, see _common.split_oversized_lists).
     Returns None when a centroid group is out of capacity altogether
     (caller falls back to the full repack+re-split path)."""
-    L, cap = index.n_lists, index.list_cap
-    sizes = np.asarray(index.list_sizes).copy()
-    labels_np = np.asarray(labels_np, np.int64)
-    if labels_np.size and labels_np.max() >= L:
+    alloc = allocate_append_slots(
+        index.centers, index.list_sizes, index.list_cap, labels_np
+    )
+    if alloc is None:
         return None
-
-    # centroid-identity groups (split shards duplicate their parent row)
-    centers_np = np.asarray(index.centers)
-    _, inverse = np.unique(centers_np, axis=0, return_inverse=True)
-    group_members = {}
-    for lst, g in enumerate(inverse):
-        group_members.setdefault(int(g), []).append(lst)
-
-    slab = np.empty_like(labels_np)
-    slots = np.empty_like(labels_np)
-    for g in np.unique(inverse[labels_np]):
-        rows = np.nonzero(inverse[labels_np] == g)[0]
-        members = group_members[int(g)]
-        if sum(cap - sizes[m] for m in members) < len(rows):
-            return None  # group out of capacity → full repack
-        i = 0
-        for m in members:
-            spare = cap - sizes[m]
-            take = min(spare, len(rows) - i)
-            if take <= 0:
-                continue
-            sel = rows[i : i + take]
-            slab[sel] = m
-            slots[sel] = sizes[m] + np.arange(take)
-            sizes[m] += take
-            i += take
-            if i == len(rows):
-                break
-    counts_new = sizes - np.asarray(index.list_sizes)
+    slab, slots, counts_new = alloc
 
     lj = jnp.asarray(slab)
     sj = jnp.asarray(slots)

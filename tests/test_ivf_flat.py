@@ -128,3 +128,30 @@ def test_inner_product_metric(data):
     _, gt = brute_force.knn(x, q, 10, metric="inner_product")
     _, idx = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), index, q, 10)
     assert float(neighborhood_recall(np.asarray(idx), np.asarray(gt))) >= 0.99
+
+
+def test_extend_fast_path_matches_repack(monkeypatch):
+    """Spare-capacity appends must skip the repack and return identical
+    search results to the repack path (shard-aware fast extend)."""
+    key = jax.random.PRNGKey(7)
+    x, _, _ = make_blobs(key, 4000, 32, n_clusters=16, cluster_std=2.0)
+    x = np.asarray(x)[np.random.default_rng(7).permutation(4000)]
+    params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+    index = ivf_flat.build(params, x[:3800])
+    extra, ids = x[3800:], jnp.arange(3800, 4000, dtype=jnp.int32)
+
+    fast = ivf_flat.extend(index, extra, ids)
+    assert fast.list_cap == index.list_cap and fast.n_lists == index.n_lists
+    assert fast.size == 4000
+
+    monkeypatch.setattr(
+        ivf_flat, "allocate_append_slots", lambda *a, **k: None
+    )
+    slow = ivf_flat.extend(index, extra, ids)
+    q = x[:64]
+    sp = ivf_flat.SearchParams(n_probes=16)
+    _, fi = ivf_flat.search(sp, fast, q, 10)
+    _, si = ivf_flat.search(sp, slow, q, 10)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(fi), axis=1), np.sort(np.asarray(si), axis=1)
+    )
