@@ -61,6 +61,32 @@ def ivf_pq_reconstruct_list(
     reconstructions mapped back through the orthonormal rotation."""
     size = int(index.list_sizes[list_id])
     y_rot = index.list_data[list_id, :size].astype(jnp.float32)  # [size, rot]
+    if index.list_data.dtype == jnp.int8:
+        y_rot = y_rot * index.scan_scale  # dequantize the memory-lean cache
     vecs = jnp.matmul(y_rot, index.rotation)  # R^T maps rotated → original
     ids = np.asarray(index.list_index[list_id])[:size]
     return vecs, ids
+
+
+def index_memory_footprint(index) -> dict:
+    """Per-component byte accounting of an index (HBM capacity planning —
+    the analog of the reference's index size reporting in ann-bench,
+    cpp/bench/ann/src/common/benchmark.hpp index-size counter).
+
+    Works on any index type here (brute_force/ivf_flat/ivf_pq/cagra):
+    every array-valued attribute is counted; returns
+    {attr: bytes, ..., "total": bytes}.
+    """
+    out = {}
+    total = 0
+    for name, val in vars(index).items():
+        nbytes = None
+        if isinstance(val, np.ndarray):
+            nbytes = int(val.nbytes)
+        elif isinstance(val, jax.Array):
+            nbytes = int(np.dtype(val.dtype).itemsize * val.size)
+        if nbytes is not None:
+            out[name] = nbytes
+            total += nbytes
+    out["total"] = total
+    return out

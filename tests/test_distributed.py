@@ -69,3 +69,29 @@ def test_sharded_int8_cache_dequantized():
     assert sharded["list_data"].dtype == jnp.bfloat16
     _, ids = sharded_ivf_pq_search(comms, sharded, x[:16], 1, n_probes=10)
     assert (np.asarray(ids)[:, 0] == np.arange(16)).mean() >= 0.9
+
+
+def test_distributed_kmeans_fit_matches_single_device():
+    """Full distributed fit: inertia non-increasing and close to a
+    single-device kmeans on the gathered data (BASELINE config #5)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from raft_tpu.comms.distributed import kmeans_fit
+    from raft_tpu.cluster import kmeans
+
+    key = jax.random.PRNGKey(9)
+    x, _, _ = make_blobs(key, 4096, 16, n_clusters=12, cluster_std=0.5)
+    comms = Comms(make_mesh(8))
+    xs = jax.device_put(x, NamedSharding(comms.mesh, P(comms.axis, None)))
+
+    c, hist = kmeans_fit(comms, xs, 12, n_iters=15, seed=3)
+    hist = np.asarray(hist)
+    valid = np.isfinite(hist)
+    assert valid.any()
+    h = hist[valid]
+    assert (np.diff(h) <= 1e-3 * h[0] + 1e-6).all()  # monotone to tolerance
+
+    ref_c, _, _ = kmeans.fit(
+        kmeans.KMeansParams(n_clusters=12, max_iter=25, seed=3), np.asarray(x)
+    )
+    ref_cost = float(kmeans.cluster_cost(np.asarray(x), ref_c))
+    assert h[-1] <= ref_cost * 1.25 + 1e-6

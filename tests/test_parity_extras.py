@@ -116,3 +116,38 @@ def test_interruptible_sync_cancellation():
     with pytest.raises(InterruptedError):
         res.sync()
     res.sync()  # flag cleared by the failed check (reference behavior)
+
+
+def test_reconstruct_list_int8_dequantizes(rng):
+    """int8 scan caches must dequantize before mapping back through the
+    rotation (regression: raw int8 lattice values are ~127/scale too big)."""
+    from raft_tpu.neighbors import helpers, ivf_pq
+
+    x = (rng.standard_normal((2000, 32)) * 2).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(
+            n_lists=8, pq_dim=16, kmeans_n_iters=3, decoded_dtype="int8"
+        ),
+        x,
+    )
+    recon, rids = helpers.ivf_pq_reconstruct_list(idx, 0)
+    orig = x[np.asarray(rids)]
+    err = np.linalg.norm(np.asarray(recon) - orig, axis=1)
+    scale = np.linalg.norm(orig, axis=1).mean()
+    assert err.mean() < scale  # PQ-level distortion, not 1/scan_scale blowup
+
+
+def test_index_memory_footprint(rng):
+    from raft_tpu.neighbors import helpers, ivf_pq
+
+    x = rng.standard_normal((1000, 32)).astype(np.float32)
+    bf16 = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=16, kmeans_n_iters=3), x)
+    i8 = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=16, kmeans_n_iters=3, decoded_dtype="int8"),
+        x,
+    )
+    f_bf16 = helpers.index_memory_footprint(bf16)
+    f_i8 = helpers.index_memory_footprint(i8)
+    assert f_bf16["total"] > 0 and "list_data" in f_bf16
+    # int8 cache is half the bf16 scan-cache bytes
+    assert f_i8["list_data"] * 2 == f_bf16["list_data"]
