@@ -79,22 +79,48 @@ def split_oversized_lists(
     return labels, np.asarray(center_map, np.int64)
 
 
+def subsample_trainset(dataset, n_train: int, seed: int):
+    """Host-side no-replacement row subsample → gathered rows (input dtype).
+
+    The indices are drawn with numpy: a device-side no-replacement
+    ``jax.random.choice`` lowers to a full-n sort whose one-off XLA compile
+    costs ~20 s through the TPU tunnel; only the O(n_train) gather runs on
+    device. (ref: trainset subsampling, ivf_pq_build.cuh:1706-1766)"""
+    import jax.numpy as _jnp
+
+    n = dataset.shape[0]
+    idx = np.random.default_rng(seed).choice(n, size=n_train, replace=False)
+    return dataset[_jnp.asarray(np.sort(idx))]
+
+
 def pack_padded_lists(
     payload: np.ndarray,
     ids: np.ndarray,
     labels: np.ndarray,
     n_lists: int,
     max_cap: Optional[int] = None,
+    headroom: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Scatter rows into the padded [n_lists', cap, ...] layout (host-side;
     the analog of the reference's per-list code/vector packing,
     ivf_flat_build.cuh:88-154). Returns (list_payload, list_index, sizes,
     center_map); cap is the max list size rounded up to the sublane
-    multiple (8). With ``max_cap`` set, oversized lists are split (see
-    split_oversized_lists) so cap ≤ round_up(max_cap, 8) regardless of
-    cluster skew; center_map tells the caller how to expand its centroid
-    rows (identity when nothing split)."""
+    multiple (8) — plus ~12.5% growth headroom when ``headroom`` is set, so
+    even the fullest list keeps spare slots and in-place extends
+    (allocate_append_slots) don't immediately fall back to a repack (pass
+    it only for extendable indexes: static ones would scan the padding on
+    every query for nothing). With ``max_cap`` set, oversized lists are
+    split (see split_oversized_lists) so cap ≤ round_up(max_cap, 8)
+    regardless of cluster skew; center_map tells the caller how to expand
+    its centroid rows (identity when nothing split)."""
     from raft_tpu.core import native
+
+    def with_headroom(base: int) -> int:
+        cap = base + max(8, base // 8) if headroom else base
+        cap = max(8, round_up(cap, 8))
+        if max_cap is not None:
+            cap = min(cap, round_up(max_cap, 8))
+        return max(cap, round_up(max(base, 1), 8))  # never below actual max
 
     n = payload.shape[0]
     labels = np.asarray(labels, np.int64)
@@ -104,6 +130,7 @@ def pack_padded_lists(
         slot, lst, center_map, cap = native.pack_list_layout(
             labels, n_lists, max_cap
         )
+        cap = with_headroom(cap)
         n_lists = len(center_map)
         list_payload = np.zeros((n_lists, cap) + payload.shape[1:], payload.dtype)
         list_index = np.full((n_lists, cap), -1, np.int32)
@@ -118,7 +145,7 @@ def pack_padded_lists(
     else:
         center_map = np.arange(n_lists, dtype=np.int64)
     sizes = np.bincount(labels, minlength=n_lists)
-    cap = max(8, round_up(int(sizes.max()) if n else 8, 8))
+    cap = with_headroom(int(sizes.max()) if n else 8)
     list_payload = np.zeros((n_lists, cap) + payload.shape[1:], payload.dtype)
     list_index = np.full((n_lists, cap), -1, np.int32)
     order = np.argsort(labels, kind="stable")

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC, pairwise_distance
-from raft_tpu.neighbors._common import pack_padded_lists
+from raft_tpu.neighbors._common import pack_padded_lists, subsample_trainset
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
 
@@ -77,9 +77,9 @@ def build(
     if canonical not in _SUPPORTED:
         raise ValueError(f"ball_cover supports {_SUPPORTED}, got {metric}")
     L = n_landmarks or max(1, int(np.sqrt(n)))
-    key = jax.random.PRNGKey(seed)
-    pick = jax.random.choice(key, n, shape=(L,), replace=False)
-    landmarks = x[pick]
+    # host-side landmark draw (see _common.subsample_trainset: a device
+    # no-replacement choice compiles a full-n sort, ~20 s via the tunnel)
+    landmarks = subsample_trainset(x, L, seed)
     base = "haversine" if canonical == "haversine" else "sqeuclidean"
     dists = _dist(x, landmarks, base)
     labels = jnp.argmin(dists, axis=1).astype(jnp.int32)

@@ -41,6 +41,7 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
     allocate_append_slots,
+    subsample_trainset,
     coarse_select,
     invalid_mask,
     default_max_cap,
@@ -123,6 +124,7 @@ def _pack_lists(
     list_data, list_index, sizes, center_map = pack_padded_lists(
         dataset, ids, labels, n_lists,
         max_cap=default_max_cap(dataset.shape[0], n_lists),
+        headroom=True,
     )
     norms = np.full(list_index.shape, np.inf, np.float32)
     valid = list_index >= 0
@@ -160,12 +162,11 @@ def build(
         n_iters=params.kmeans_n_iters, metric=kb_metric, seed=params.seed
     )
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
-    if n_train < n:
-        key = jax.random.PRNGKey(params.seed)
-        train_idx = jax.random.choice(key, n, shape=(n_train,), replace=False)
-        trainset = dataset[train_idx]
-    else:
-        trainset = dataset
+    trainset = (
+        subsample_trainset(dataset, n_train, params.seed)
+        if n_train < n
+        else dataset
+    )
     centers = kmeans_balanced.fit(kb, trainset.astype(jnp.float32), params.n_lists, res=res)
 
     index = Index(

@@ -60,6 +60,7 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
     allocate_append_slots,
+    subsample_trainset,
     coarse_select,
     default_max_cap,
     invalid_mask,
@@ -339,6 +340,7 @@ def _pack_code_lists(
     list_codes, list_index, sizes, center_map = pack_padded_lists(
         codes, ids, labels, n_lists,
         max_cap=default_max_cap(codes.shape[0], n_lists),
+        headroom=True,
     )
     centers_rot = np.asarray(centers_rot)[center_map]
     if codebook_kind == CODEBOOK_PER_CLUSTER:
@@ -379,13 +381,13 @@ def build(
     rot_dim = pq_dim * pq_len
 
     key = jax.random.PRNGKey(params.seed)
-    k_train, k_rot, k_cb = jax.random.split(key, 3)
+    _, k_rot, k_cb = jax.random.split(key, 3)
 
-    # --- trainset subsample (ref :1706-1766)
+    # --- trainset subsample (ref :1706-1766; host-side index draw — see
+    # _common.subsample_trainset for the compile-cost rationale)
     n_train = min(n, max(params.n_lists * 2, int(n * params.kmeans_trainset_fraction)))
     if n_train < n:
-        train_idx = jax.random.choice(k_train, n, shape=(n_train,), replace=False)
-        trainset = dataset[train_idx].astype(jnp.float32)
+        trainset = subsample_trainset(dataset, n_train, params.seed).astype(jnp.float32)
     else:
         trainset = dataset.astype(jnp.float32)
 
