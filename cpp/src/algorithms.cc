@@ -33,19 +33,25 @@ enum class metric_code : int {
   cosine = 3,
 };
 
+// Exact scoring + top-k of a candidate list per query. candidates ==
+// nullptr means the identity list 0..k_cand-1 (full-dataset scan — the
+// brute-force kNN case). `scratch` must be presized to k_cand by the
+// spawning thread so no allocation (and no uncatchable bad_alloc) happens
+// on worker threads.
 void refine_rows(const float* dataset, std::int64_t n, std::int64_t d,
                  const float* queries, const std::int32_t* candidates,
                  std::int64_t k_cand, std::int64_t k, metric_code metric,
                  float* out_d, std::int32_t* out_i, std::int64_t q_begin,
-                 std::int64_t q_end) {
-  std::vector<std::pair<float, std::int32_t>> scored(k_cand);
+                 std::int64_t q_end,
+                 std::vector<std::pair<float, std::int32_t>>& scored) {
   for (std::int64_t q = q_begin; q < q_end; ++q) {
     const float* qv = queries + q * d;
     float q2 = 0.f;
     for (std::int64_t j = 0; j < d; ++j) q2 += qv[j] * qv[j];
     const float qnorm = std::max(std::sqrt(q2), 1e-12f);
     for (std::int64_t c = 0; c < k_cand; ++c) {
-      std::int32_t id = candidates[q * k_cand + c];
+      std::int32_t id = candidates ? candidates[q * k_cand + c]
+                                   : static_cast<std::int32_t>(c);
       if (id < 0 || id >= n) {
         scored[c] = {std::numeric_limits<float>::infinity(), -1};
         continue;
@@ -69,6 +75,10 @@ void refine_rows(const float* dataset, std::int64_t n, std::int64_t d,
           if (metric == metric_code::euclidean) dist = std::sqrt(dist);
         }
       }
+      // NaN scores (masked/failed upstream values) must not reach
+      // partial_sort: NaN breaks its strict-weak-ordering contract (UB).
+      // Map to +inf in selection space — worst, like invalid candidates.
+      if (std::isnan(dist)) dist = std::numeric_limits<float>::infinity();
       scored[c] = {dist, id};
     }
     std::partial_sort(scored.begin(), scored.begin() + k, scored.end());
@@ -102,17 +112,115 @@ int rt_refine_host(const float* dataset, int64_t n, int64_t d,
     n_threads = std::max(1, std::min<int>(n_threads, 64));
     auto m = static_cast<metric_code>(metric);
     if (n_q < 64 || n_threads == 1) {
+      std::vector<std::pair<float, std::int32_t>> scratch(k_cand);
       refine_rows(dataset, n, d, queries, candidates, k_cand, k, m, out_d,
-                  out_i, 0, n_q);
+                  out_i, 0, n_q, scratch);
+      return 0;
+    }
+    std::int64_t chunk = (n_q + n_threads - 1) / n_threads;
+    int used = static_cast<int>(std::min<std::int64_t>(
+        n_threads, (n_q + chunk - 1) / chunk));
+    // per-thread scratch allocated HERE so bad_alloc surfaces as an error
+    // code instead of std::terminate on a worker thread
+    std::vector<std::vector<std::pair<float, std::int32_t>>> scratch(used);
+    for (auto& s : scratch) s.resize(k_cand);
+    std::vector<std::thread> ts;
+    for (int t = 0; t < used; ++t) {
+      std::int64_t b = t * chunk, e = std::min<std::int64_t>(n_q, b + chunk);
+      if (b >= e) break;
+      ts.emplace_back([&, t, b, e] {
+        refine_rows(dataset, n, d, queries, candidates, k_cand, k, m, out_d,
+                    out_i, b, e, scratch[t]);
+      });
+    }
+    for (auto& t : ts) t.join();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
+// Host brute-force kNN, threaded over queries — the groundtruth-generation
+// path (ref: raft-ann-bench generate_groundtruth; exposed like
+// raft_runtime/neighbors/brute_force.hpp). Scans the whole dataset per
+// query via refine_rows' nullptr-candidates (identity list) mode, so both
+// entry points share one metric/scoring/selection implementation.
+int rt_knn_host(const float* dataset, int64_t n, int64_t d,
+                const float* queries, int64_t n_q, int64_t k, int metric,
+                float* out_d, int32_t* out_i, int n_threads) {
+  try {
+    RAFT_TPU_EXPECTS(k <= n, "k exceeds dataset size");
+    RAFT_TPU_EXPECTS(n <= std::numeric_limits<std::int32_t>::max(),
+                     "rt_knn_host returns int32 ids; dataset too large");
+    if (n_threads <= 0)
+      n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    n_threads = std::max(1, std::min<int>(n_threads, 64));
+    auto m = static_cast<metric_code>(metric);
+    if (n_q < 16 || n_threads == 1) {
+      std::vector<std::pair<float, std::int32_t>> scratch(n);
+      refine_rows(dataset, n, d, queries, nullptr, n, k, m, out_d, out_i, 0,
+                  n_q, scratch);
+      return 0;
+    }
+    std::int64_t chunk = (n_q + n_threads - 1) / n_threads;
+    int used = static_cast<int>(std::min<std::int64_t>(
+        n_threads, (n_q + chunk - 1) / chunk));
+    std::vector<std::vector<std::pair<float, std::int32_t>>> scratch(used);
+    for (auto& s : scratch) s.resize(n);  // alloc on the spawning thread
+    std::vector<std::thread> ts;
+    for (int t = 0; t < used; ++t) {
+      std::int64_t b = t * chunk, e = std::min<std::int64_t>(n_q, b + chunk);
+      if (b >= e) break;
+      ts.emplace_back([&, t, b, e] {
+        refine_rows(dataset, n, d, queries, nullptr, n, k, m, out_d, out_i,
+                    b, e, scratch[t]);
+      });
+    }
+    for (auto& t : ts) t.join();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
+// Host batched top-k selection (ref: raft_runtime/matrix/select_k.hpp):
+// per-row partial sort, threaded over rows; select_min=0 takes largest.
+int rt_select_k_host(const float* scores, int64_t rows, int64_t cols,
+                     int64_t k, int select_min, float* out_v,
+                     int32_t* out_i, int n_threads) {
+  try {
+    RAFT_TPU_EXPECTS(k <= cols, "k exceeds row length");
+    if (n_threads <= 0)
+      n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    n_threads = std::max(1, std::min<int>(n_threads, 64));
+    auto worker = [&](std::int64_t b, std::int64_t e) {
+      std::vector<std::pair<float, std::int32_t>> row(cols);
+      for (std::int64_t r = b; r < e; ++r) {
+        const float* s = scores + r * cols;
+        for (std::int64_t c = 0; c < cols; ++c) {
+          float v = select_min ? s[c] : -s[c];
+          // NaN would break partial_sort's strict weak ordering (UB);
+          // rank it worst, consistent with refine_rows
+          if (std::isnan(v)) v = std::numeric_limits<float>::infinity();
+          row[c] = {v, static_cast<std::int32_t>(c)};
+        }
+        std::partial_sort(row.begin(), row.begin() + k, row.end());
+        for (std::int64_t j = 0; j < k; ++j) {
+          out_v[r * k + j] = select_min ? row[j].first : -row[j].first;
+          out_i[r * k + j] = row[j].second;
+        }
+      }
+    };
+    if (rows < 16 || n_threads == 1) {
+      worker(0, rows);
       return 0;
     }
     std::vector<std::thread> ts;
-    std::int64_t chunk = (n_q + n_threads - 1) / n_threads;
+    std::int64_t chunk = (rows + n_threads - 1) / n_threads;
     for (int t = 0; t < n_threads; ++t) {
-      std::int64_t b = t * chunk, e = std::min<std::int64_t>(n_q, b + chunk);
+      std::int64_t b = t * chunk, e = std::min<std::int64_t>(rows, b + chunk);
       if (b >= e) break;
-      ts.emplace_back(refine_rows, dataset, n, d, queries, candidates, k_cand,
-                      k, m, out_d, out_i, b, e);
+      ts.emplace_back(worker, b, e);
     }
     for (auto& t : ts) t.join();
     return 0;

@@ -132,6 +132,18 @@ def _load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.rt_knn_host.restype = ctypes.c_int
+        lib.rt_knn_host.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,  # dataset
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,  # queries, k
+            ctypes.c_int,                                     # metric
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,   # outs, threads
+        ]
+        lib.rt_select_k_host.restype = ctypes.c_int
+        lib.rt_select_k_host.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
         _LIB = lib
         return _LIB
 
@@ -279,6 +291,66 @@ def refine_host(
     if code != 0:
         raise RuntimeError(_lib().rt_alg_last_error().decode())
     return out_d, out_i
+
+
+def knn_host(
+    dataset: np.ndarray,
+    queries: np.ndarray,
+    k: int,
+    metric: str = "sqeuclidean",
+    n_threads: int = 0,
+):
+    """Native exact brute-force kNN, threaded over queries — the
+    groundtruth-generation path (ref: raft-ann-bench generate_groundtruth;
+    raft_runtime/neighbors/brute_force.hpp role). Returns
+    (distances [q, k] f32, indices [q, k] i32)."""
+    if metric not in _METRIC_CODES:
+        raise ValueError(f"unsupported native knn metric {metric!r}")
+    dataset = np.ascontiguousarray(dataset, np.float32)
+    queries = np.ascontiguousarray(queries, np.float32)
+    if dataset.ndim != 2 or queries.ndim != 2:
+        raise ValueError("dataset and queries must be 2-D")
+    if queries.shape[1] != dataset.shape[1]:
+        raise ValueError(
+            f"queries dim {queries.shape[1]} != dataset dim {dataset.shape[1]}"
+        )
+    n_q = queries.shape[0]
+    out_d = np.empty((n_q, k), np.float32)
+    out_i = np.empty((n_q, k), np.int32)
+    code = _lib().rt_knn_host(
+        dataset.ctypes.data_as(ctypes.c_void_p), dataset.shape[0], dataset.shape[1],
+        queries.ctypes.data_as(ctypes.c_void_p), n_q, k,
+        _METRIC_CODES[metric],
+        out_d.ctypes.data_as(ctypes.c_void_p),
+        out_i.ctypes.data_as(ctypes.c_void_p),
+        n_threads,
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return out_d, out_i
+
+
+def select_k_host(
+    scores: np.ndarray, k: int, select_min: bool = True, n_threads: int = 0
+):
+    """Native batched top-k over host rows (ref: raft_runtime/matrix/
+    select_k.hpp role). Returns (values [rows, k] f32, indices i32)."""
+    scores = np.ascontiguousarray(scores, np.float32)
+    if scores.ndim != 2:
+        raise ValueError("scores must be 2-D")
+    rows, cols = scores.shape
+    out_v = np.empty((rows, k), np.float32)
+    out_i = np.empty((rows, k), np.int32)
+    code = _lib().rt_select_k_host(
+        scores.ctypes.data_as(ctypes.c_void_p), rows, cols, k,
+        1 if select_min else 0,
+        out_v.ctypes.data_as(ctypes.c_void_p),
+        out_i.ctypes.data_as(ctypes.c_void_p),
+        n_threads,
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return out_v, out_i
 
 
 def pack_list_layout(labels: np.ndarray, n_lists: int, max_cap: int):

@@ -66,6 +66,7 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     adaptive_centers: bool = False
     add_data_on_build: bool = True
+    conservative_memory_allocation: bool = False  # ref ivf_flat_types.hpp
     seed: int = 0
 
 
@@ -95,6 +96,9 @@ class Index:
         self.list_index = list_index
         self.list_sizes = list_sizes
         self.list_norms = list_norms
+        # list growth headroom policy (False under
+        # conservative_memory_allocation; not serialized)
+        self.headroom = True
 
     @property
     def n_lists(self) -> int:
@@ -114,7 +118,8 @@ class Index:
 
 
 def _pack_lists(
-    dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int, metric: str
+    dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int,
+    metric: str, headroom: bool = True,
 ):
     """Pack into the padded [n_lists', cap, dim] layout + per-slot norms.
 
@@ -124,7 +129,7 @@ def _pack_lists(
     list_data, list_index, sizes, center_map = pack_padded_lists(
         dataset, ids, labels, n_lists,
         max_cap=default_max_cap(dataset.shape[0], n_lists),
-        headroom=True,
+        headroom=headroom,
     )
     norms = np.full(list_index.shape, np.inf, np.float32)
     valid = list_index >= 0
@@ -177,6 +182,7 @@ def build(
         jnp.zeros((params.n_lists,), jnp.int32),
         jnp.full((params.n_lists, 8), jnp.inf, jnp.float32),
     )
+    index.headroom = not params.conservative_memory_allocation
     if params.add_data_on_build:
         index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
     _log.debug(
@@ -225,7 +231,7 @@ def extend(
             slab, slots, counts_new = alloc
             lj, sj = jnp.asarray(slab), jnp.asarray(slots)
             rows32 = new_vectors.astype(jnp.float32)
-            return Index(
+            out = Index(
                 index.metric,
                 index.centers,
                 index.list_data.at[lj, sj].set(new_vectors),
@@ -237,6 +243,8 @@ def extend(
                     jnp.sum(rows32 * rows32, axis=-1)
                 ),
             )
+            out.headroom = getattr(index, "headroom", True)
+            return out
 
     # merge with existing content host-side, then re-pack; split shards from
     # a previous pack are first merged back to their parent list so repeated
@@ -250,10 +258,13 @@ def extend(
     uniq, all_labels = merge_split_lists(np.asarray(index.centers), all_labels)
     base_centers = index.centers[jnp.asarray(uniq)]
     list_data, list_index, list_sizes, list_norms, center_map = _pack_lists(
-        all_rows, all_ids, all_labels, len(uniq), index.metric
+        all_rows, all_ids, all_labels, len(uniq), index.metric,
+        headroom=getattr(index, "headroom", True),
     )
     centers = base_centers[jnp.asarray(center_map)]
-    return Index(index.metric, centers, list_data, list_index, list_sizes, list_norms)
+    out = Index(index.metric, centers, list_data, list_index, list_sizes, list_norms)
+    out.headroom = getattr(index, "headroom", True)
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("n_probes", "k", "metric", "query_tile"))

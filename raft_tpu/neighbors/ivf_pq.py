@@ -160,6 +160,10 @@ class Index:
         self.list_y2 = list_y2
         # dequantization scale of an int8 scan cache (1.0 for float caches)
         self.scan_scale = scan_scale
+        # list growth headroom policy (False under
+        # conservative_memory_allocation; not serialized — load() defaults
+        # True, matching the reference's build-time-only knob)
+        self.headroom = True
 
     @property
     def n_lists(self) -> int:
@@ -332,6 +336,7 @@ def _pack_code_lists(
     codebook_kind: str,
     centers_rot: np.ndarray,
     dtype,
+    headroom: bool = True,
 ):
     """Scatter encoded rows into the padded [n_lists', cap, pq_dim] layout
     and build the decoded scan cache. Oversized lists are split with
@@ -340,7 +345,7 @@ def _pack_code_lists(
     list_codes, list_index, sizes, center_map = pack_padded_lists(
         codes, ids, labels, n_lists,
         max_cap=default_max_cap(codes.shape[0], n_lists),
-        headroom=True,
+        headroom=headroom,
     )
     centers_rot = np.asarray(centers_rot)[center_map]
     if codebook_kind == CODEBOOK_PER_CLUSTER:
@@ -448,6 +453,7 @@ def build(
         jnp.zeros((params.n_lists, 8, rot_dim), dec_dtype),
         jnp.zeros((params.n_lists, 8), jnp.float32),
     )
+    index.headroom = not params.conservative_memory_allocation
     if params.add_data_on_build:
         index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
     _log.debug(
@@ -513,7 +519,7 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
 
     list_codes = np.array(index.list_codes, copy=True)
     list_codes[slab, slots] = codes_np
-    return Index(
+    out = Index(
         index.metric, index.codebook_kind, index.pq_bits,
         index.centers, index.centers_rot, index.rotation, index.codebook,
         list_codes,
@@ -523,6 +529,8 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
         index.list_y2.at[lj, sj].set(y2_rows),
         index.scan_scale,
     )
+    out.headroom = getattr(index, "headroom", True)
+    return out
 
 
 @traced("ivf_pq.extend")
@@ -598,6 +606,7 @@ def extend(
         all_codes, all_ids, all_labels, len(uniq),
         np.asarray(base_codebook), index.codebook_kind,
         np.asarray(base_centers_rot), index.list_data.dtype,
+        headroom=getattr(index, "headroom", True),
     )
     cmap_j = jnp.asarray(cmap)
     codebook = (
@@ -605,12 +614,14 @@ def extend(
         if index.codebook_kind == CODEBOOK_PER_CLUSTER
         else index.codebook
     )
-    return Index(
+    out = Index(
         index.metric, index.codebook_kind, index.pq_bits,
         base_centers[cmap_j], base_centers_rot[cmap_j], index.rotation,
         codebook, list_codes, list_index, list_sizes, list_data, list_y2,
         scan_scale,
     )
+    out.headroom = getattr(index, "headroom", True)
+    return out
 
 
 @functools.partial(

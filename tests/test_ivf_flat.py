@@ -155,3 +155,23 @@ def test_extend_fast_path_matches_repack(monkeypatch):
     np.testing.assert_array_equal(
         np.sort(np.asarray(fi), axis=1), np.sort(np.asarray(si), axis=1)
     )
+
+
+def test_conservative_memory_allocation_skips_headroom():
+    """conservative_memory_allocation (ref ivf_flat/ivf_pq index_params)
+    must turn off list growth headroom: cap == max list size rounded to 8."""
+    key = jax.random.PRNGKey(11)
+    x, _, _ = make_blobs(key, 2000, 16, n_clusters=8)
+    x = np.asarray(x)
+    tight = ivf_flat.build(
+        ivf_flat.IndexParams(
+            n_lists=8, kmeans_n_iters=3, conservative_memory_allocation=True
+        ),
+        x,
+    )
+    roomy = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), x
+    )
+    assert tight.list_cap <= roomy.list_cap
+    sizes = np.asarray(tight.list_sizes)
+    assert tight.list_cap == -(-int(sizes.max()) // 8) * 8

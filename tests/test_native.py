@@ -158,3 +158,48 @@ def test_native_core_units():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "core_test ok" in out.stdout
+
+
+def test_native_knn_host(rng):
+    """Native brute-force kNN matches numpy exactly (groundtruth path)."""
+    from raft_tpu.core import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    x = rng.standard_normal((500, 24)).astype(np.float32)
+    q = rng.standard_normal((40, 24)).astype(np.float32)
+    d, i = native.knn_host(x, q, 5)
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1)[:, :5]
+    np.testing.assert_array_equal(i, want)
+    np.testing.assert_allclose(
+        d, np.take_along_axis(d2, want, 1), rtol=1e-4, atol=1e-4
+    )
+    # inner product: largest similarity first, similarities returned as-is
+    dip, iip = native.knn_host(x, q, 5, metric="inner_product")
+    ip = q @ x.T
+    want_ip = np.argsort(-ip, axis=1)[:, :5]
+    np.testing.assert_array_equal(iip, want_ip)
+    np.testing.assert_allclose(
+        dip, np.take_along_axis(ip, want_ip, 1), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_native_select_k_host(rng):
+    from raft_tpu.core import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    s = rng.standard_normal((30, 200)).astype(np.float32)
+    v, i = native.select_k_host(s, 7)
+    want = np.sort(s, axis=1)[:, :7]
+    np.testing.assert_allclose(v, want, rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(s, i, 1), v, rtol=1e-6)
+    v2, i2 = native.select_k_host(s, 7, select_min=False)
+    np.testing.assert_allclose(v2, np.sort(s, 1)[:, ::-1][:, :7], rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(s, i2, 1), v2, rtol=1e-6)
+    # NaN scores rank worst instead of corrupting the sort
+    s_nan = s.copy()
+    s_nan[:, 0] = np.nan
+    v3, i3 = native.select_k_host(s_nan, 7)
+    assert not np.isnan(v3).any() and (i3 != 0).all()
