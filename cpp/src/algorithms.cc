@@ -35,59 +35,72 @@ enum class metric_code : int {
 
 // Exact scoring + top-k of a candidate list per query. candidates ==
 // nullptr means the identity list 0..k_cand-1 (full-dataset scan — the
-// brute-force kNN case). `scratch` must be presized to k_cand by the
-// spawning thread so no allocation (and no uncatchable bad_alloc) happens
-// on worker threads.
+// brute-force kNN case). Selection is a bounded size-k max-heap, so the
+// per-thread `heap` scratch is O(k) regardless of k_cand (a full-n scored
+// buffer would cost threads×n×8 bytes on groundtruth-scale scans). The
+// spawning thread presizes `heap` (reserve k) so worker threads never
+// allocate — a bad_alloc on a std::thread would bypass the entry point's
+// try/catch and std::terminate the process.
 void refine_rows(const float* dataset, std::int64_t n, std::int64_t d,
                  const float* queries, const std::int32_t* candidates,
                  std::int64_t k_cand, std::int64_t k, metric_code metric,
                  float* out_d, std::int32_t* out_i, std::int64_t q_begin,
                  std::int64_t q_end,
-                 std::vector<std::pair<float, std::int32_t>>& scored) {
+                 std::vector<std::pair<float, std::int32_t>>& heap) {
   for (std::int64_t q = q_begin; q < q_end; ++q) {
     const float* qv = queries + q * d;
     float q2 = 0.f;
     for (std::int64_t j = 0; j < d; ++j) q2 += qv[j] * qv[j];
     const float qnorm = std::max(std::sqrt(q2), 1e-12f);
+    heap.clear();
     for (std::int64_t c = 0; c < k_cand; ++c) {
       std::int32_t id = candidates ? candidates[q * k_cand + c]
                                    : static_cast<std::int32_t>(c);
-      if (id < 0 || id >= n) {
-        scored[c] = {std::numeric_limits<float>::infinity(), -1};
-        continue;
-      }
-      const float* rv = dataset + static_cast<std::int64_t>(id) * d;
-      float ip = 0.f, rn2 = 0.f;
-      for (std::int64_t j = 0; j < d; ++j) {
-        ip += qv[j] * rv[j];
-        rn2 += rv[j] * rv[j];
-      }
       float dist;
-      switch (metric) {
-        case metric_code::inner_product:
-          dist = -ip;  // select smallest
-          break;
-        case metric_code::cosine:
-          dist = 1.f - ip / (qnorm * std::max(std::sqrt(rn2), 1e-12f));
-          break;
-        default: {  // (sq)euclidean
-          dist = std::max(q2 + rn2 - 2.f * ip, 0.f);
-          if (metric == metric_code::euclidean) dist = std::sqrt(dist);
+      if (id < 0 || id >= n) {
+        dist = std::numeric_limits<float>::infinity();
+        id = -1;
+      } else {
+        const float* rv = dataset + static_cast<std::int64_t>(id) * d;
+        float ip = 0.f, rn2 = 0.f;
+        for (std::int64_t j = 0; j < d; ++j) {
+          ip += qv[j] * rv[j];
+          rn2 += rv[j] * rv[j];
         }
+        switch (metric) {
+          case metric_code::inner_product:
+            dist = -ip;  // select smallest
+            break;
+          case metric_code::cosine:
+            dist = 1.f - ip / (qnorm * std::max(std::sqrt(rn2), 1e-12f));
+            break;
+          default: {  // (sq)euclidean
+            dist = std::max(q2 + rn2 - 2.f * ip, 0.f);
+            if (metric == metric_code::euclidean) dist = std::sqrt(dist);
+          }
+        }
+        // NaN scores (masked/failed upstream values) must not reach the
+        // heap comparisons: NaN breaks strict weak ordering (UB). Map to
+        // +inf in selection space — worst, like invalid candidates.
+        if (std::isnan(dist)) dist = std::numeric_limits<float>::infinity();
       }
-      // NaN scores (masked/failed upstream values) must not reach
-      // partial_sort: NaN breaks its strict-weak-ordering contract (UB).
-      // Map to +inf in selection space — worst, like invalid candidates.
-      if (std::isnan(dist)) dist = std::numeric_limits<float>::infinity();
-      scored[c] = {dist, id};
+      std::pair<float, std::int32_t> cand{dist, id};
+      if (static_cast<std::int64_t>(heap.size()) < k) {
+        heap.push_back(cand);
+        std::push_heap(heap.begin(), heap.end());
+      } else if (cand < heap.front()) {
+        std::pop_heap(heap.begin(), heap.end());
+        heap.back() = cand;
+        std::push_heap(heap.begin(), heap.end());
+      }
     }
-    std::partial_sort(scored.begin(), scored.begin() + k, scored.end());
+    std::sort_heap(heap.begin(), heap.end());  // ascending
     for (std::int64_t j = 0; j < k; ++j) {
-      float v = scored[j].first;
+      float v = heap[j].first;
       // IP negates unconditionally so padding (+inf in selection space)
       // comes back as -inf — worst similarity, matching the jax path
       out_d[q * k + j] = metric == metric_code::inner_product ? -v : v;
-      out_i[q * k + j] = scored[j].second;
+      out_i[q * k + j] = heap[j].second;
     }
   }
 }
@@ -112,7 +125,8 @@ int rt_refine_host(const float* dataset, int64_t n, int64_t d,
     n_threads = std::max(1, std::min<int>(n_threads, 64));
     auto m = static_cast<metric_code>(metric);
     if (n_q < 64 || n_threads == 1) {
-      std::vector<std::pair<float, std::int32_t>> scratch(k_cand);
+      std::vector<std::pair<float, std::int32_t>> scratch;
+      scratch.reserve(k);
       refine_rows(dataset, n, d, queries, candidates, k_cand, k, m, out_d,
                   out_i, 0, n_q, scratch);
       return 0;
@@ -123,7 +137,7 @@ int rt_refine_host(const float* dataset, int64_t n, int64_t d,
     // per-thread scratch allocated HERE so bad_alloc surfaces as an error
     // code instead of std::terminate on a worker thread
     std::vector<std::vector<std::pair<float, std::int32_t>>> scratch(used);
-    for (auto& s : scratch) s.resize(k_cand);
+    for (auto& s : scratch) s.reserve(k);
     std::vector<std::thread> ts;
     for (int t = 0; t < used; ++t) {
       std::int64_t b = t * chunk, e = std::min<std::int64_t>(n_q, b + chunk);
@@ -157,7 +171,8 @@ int rt_knn_host(const float* dataset, int64_t n, int64_t d,
     n_threads = std::max(1, std::min<int>(n_threads, 64));
     auto m = static_cast<metric_code>(metric);
     if (n_q < 16 || n_threads == 1) {
-      std::vector<std::pair<float, std::int32_t>> scratch(n);
+      std::vector<std::pair<float, std::int32_t>> scratch;
+      scratch.reserve(k);
       refine_rows(dataset, n, d, queries, nullptr, n, k, m, out_d, out_i, 0,
                   n_q, scratch);
       return 0;
@@ -166,7 +181,7 @@ int rt_knn_host(const float* dataset, int64_t n, int64_t d,
     int used = static_cast<int>(std::min<std::int64_t>(
         n_threads, (n_q + chunk - 1) / chunk));
     std::vector<std::vector<std::pair<float, std::int32_t>>> scratch(used);
-    for (auto& s : scratch) s.resize(n);  // alloc on the spawning thread
+    for (auto& s : scratch) s.reserve(k);  // alloc on the spawning thread
     std::vector<std::thread> ts;
     for (int t = 0; t < used; ++t) {
       std::int64_t b = t * chunk, e = std::min<std::int64_t>(n_q, b + chunk);
@@ -190,11 +205,13 @@ int rt_select_k_host(const float* scores, int64_t rows, int64_t cols,
                      int32_t* out_i, int n_threads) {
   try {
     RAFT_TPU_EXPECTS(k <= cols, "k exceeds row length");
+    RAFT_TPU_EXPECTS(cols <= std::numeric_limits<std::int32_t>::max(),
+                     "rt_select_k_host returns int32 indices; rows too wide");
     if (n_threads <= 0)
       n_threads = static_cast<int>(std::thread::hardware_concurrency());
     n_threads = std::max(1, std::min<int>(n_threads, 64));
-    auto worker = [&](std::int64_t b, std::int64_t e) {
-      std::vector<std::pair<float, std::int32_t>> row(cols);
+    auto worker = [&](std::int64_t b, std::int64_t e,
+                      std::vector<std::pair<float, std::int32_t>>& row) {
       for (std::int64_t r = b; r < e; ++r) {
         const float* s = scores + r * cols;
         for (std::int64_t c = 0; c < cols; ++c) {
@@ -212,15 +229,21 @@ int rt_select_k_host(const float* scores, int64_t rows, int64_t cols,
       }
     };
     if (rows < 16 || n_threads == 1) {
-      worker(0, rows);
+      std::vector<std::pair<float, std::int32_t>> row(cols);
+      worker(0, rows, row);
       return 0;
     }
-    std::vector<std::thread> ts;
     std::int64_t chunk = (rows + n_threads - 1) / n_threads;
-    for (int t = 0; t < n_threads; ++t) {
+    int used = static_cast<int>(std::min<std::int64_t>(
+        n_threads, (rows + chunk - 1) / chunk));
+    // per-thread scratch allocated on the spawning thread (see refine_rows)
+    std::vector<std::vector<std::pair<float, std::int32_t>>> scratch(used);
+    for (auto& s : scratch) s.resize(cols);
+    std::vector<std::thread> ts;
+    for (int t = 0; t < used; ++t) {
       std::int64_t b = t * chunk, e = std::min<std::int64_t>(rows, b + chunk);
       if (b >= e) break;
-      ts.emplace_back(worker, b, e);
+      ts.emplace_back([&, t, b, e] { worker(b, e, scratch[t]); });
     }
     for (auto& t : ts) t.join();
     return 0;

@@ -47,6 +47,72 @@ def read_bin(path: str, dtype=None) -> np.ndarray:
     return data.reshape(int(n), int(dim))
 
 
+# --- TEXMEX .fvecs/.ivecs/.bvecs (sift/gist distributions: every row is
+# [dim:int32][payload]) — the other standard ANN interchange format the
+# reference's docs point users at (docs/source/raft_ann_benchmarks.md).
+
+_VECS_DTYPES = {"fvecs": np.float32, "ivecs": np.int32, "bvecs": np.uint8}
+
+
+def write_vecs(path: str, arr: np.ndarray) -> None:
+    ext = path.rsplit(".", 1)[-1]
+    dtype = _VECS_DTYPES[ext]
+    arr = np.ascontiguousarray(arr, dtype)
+    n, d = arr.shape
+    dims = np.full((n, 1), d, np.int32)
+    if dtype == np.uint8:
+        rows = np.concatenate([dims.view(np.uint8).reshape(n, 4), arr], axis=1)
+    else:
+        rows = np.concatenate([dims.view(dtype), arr], axis=1)
+    with open(path, "wb") as fh:
+        fh.write(rows.tobytes())
+
+
+def read_vecs(path: str) -> np.ndarray:
+    ext = path.rsplit(".", 1)[-1]
+    dtype = _VECS_DTYPES[ext]
+    raw = np.fromfile(path, np.uint8)
+    if raw.size == 0:
+        return np.zeros((0, 0), dtype)
+    d = int(np.frombuffer(raw[:4].tobytes(), np.int32)[0])
+    itemsize = np.dtype(dtype).itemsize
+    row_bytes = 4 + d * itemsize
+    if raw.size % row_bytes:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of row {row_bytes}")
+    rows = raw.reshape(-1, row_bytes)
+    return (
+        rows[:, 4:].reshape(-1).view(dtype).reshape(rows.shape[0], d).copy()
+    )
+
+
+def load_hdf5(path: str, name: str = "") -> "Dataset":
+    """Read an ann-benchmarks HDF5 file (train/test/neighbors/distances
+    groups). Requires ``h5py``; raises a clear error when absent (this image
+    doesn't ship it — externally prepared files convert via write_bin)."""
+    try:
+        import h5py  # type: ignore
+    except ImportError as e:  # pragma: no cover - h5py not in this image
+        raise RuntimeError(
+            "load_hdf5 requires h5py; convert the file to the big-ann .fbin "
+            "layout (write_bin) on a machine that has it"
+        ) from e
+    with h5py.File(path, "r") as f:  # pragma: no cover - h5py not in image
+        metric = {"euclidean": "sqeuclidean", "angular": "cosine"}.get(
+            f.attrs.get("distance", "euclidean"), "sqeuclidean"
+        )
+        ds = Dataset(
+            name=name or os.path.basename(path),
+            base=np.asarray(f["train"]),
+            queries=np.asarray(f["test"]),
+            metric=metric,
+        )
+        if "neighbors" in f:
+            ds.gt_neighbors = np.asarray(f["neighbors"], np.int32)
+        if "distances" in f:
+            ds.gt_distances = np.asarray(f["distances"], np.float32)
+        return ds
+
+
 @dataclass
 class Dataset:
     name: str
@@ -141,6 +207,27 @@ def save(ds: Dataset, directory: str) -> None:
 
 
 def load(directory: str, name: str = "", metric: str = "sqeuclidean") -> Dataset:
+    """Load a dataset directory in either standard layout: big-ann
+    (base.fbin/query.fbin/groundtruth.*.ibin) or TEXMEX
+    (<name>_base.fvecs / _query.fvecs / _groundtruth.ivecs, the sift-1M
+    distribution layout)."""
+    if not os.path.exists(os.path.join(directory, "base.fbin")):
+        import glob as _glob
+
+        bases = sorted(_glob.glob(os.path.join(directory, "*_base.*vecs")))
+        if bases:
+            prefix = bases[0].rsplit("_base.", 1)[0]
+            ext = bases[0].rsplit(".", 1)[-1]
+            ds = Dataset(
+                name=name or os.path.basename(prefix),
+                base=read_vecs(f"{prefix}_base.{ext}"),
+                queries=read_vecs(f"{prefix}_query.{ext}"),
+                metric=metric,
+            )
+            gt = f"{prefix}_groundtruth.ivecs"
+            if os.path.exists(gt):
+                ds.gt_neighbors = read_vecs(gt).astype(np.int32)
+            return ds
     ds = Dataset(
         name=name or os.path.basename(directory.rstrip("/")),
         base=read_bin(os.path.join(directory, "base.fbin")),

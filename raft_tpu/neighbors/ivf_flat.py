@@ -89,7 +89,8 @@ class Index:
                                        slots lose every select_min)
     """
 
-    def __init__(self, metric, centers, list_data, list_index, list_sizes, list_norms):
+    def __init__(self, metric, centers, list_data, list_index, list_sizes,
+                 list_norms, headroom: bool = True):
         self.metric = metric
         self.centers = centers
         self.list_data = list_data
@@ -98,7 +99,7 @@ class Index:
         self.list_norms = list_norms
         # list growth headroom policy (False under
         # conservative_memory_allocation; not serialized)
-        self.headroom = True
+        self.headroom = headroom
 
     @property
     def n_lists(self) -> int:
@@ -181,8 +182,8 @@ def build(
         jnp.full((params.n_lists, 8), -1, jnp.int32),
         jnp.zeros((params.n_lists,), jnp.int32),
         jnp.full((params.n_lists, 8), jnp.inf, jnp.float32),
+        headroom=not params.conservative_memory_allocation,
     )
-    index.headroom = not params.conservative_memory_allocation
     if params.add_data_on_build:
         index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
     _log.debug(
@@ -231,7 +232,7 @@ def extend(
             slab, slots, counts_new = alloc
             lj, sj = jnp.asarray(slab), jnp.asarray(slots)
             rows32 = new_vectors.astype(jnp.float32)
-            out = Index(
+            return Index(
                 index.metric,
                 index.centers,
                 index.list_data.at[lj, sj].set(new_vectors),
@@ -242,9 +243,8 @@ def extend(
                 index.list_norms.at[lj, sj].set(
                     jnp.sum(rows32 * rows32, axis=-1)
                 ),
+                headroom=index.headroom,
             )
-            out.headroom = getattr(index, "headroom", True)
-            return out
 
     # merge with existing content host-side, then re-pack; split shards from
     # a previous pack are first merged back to their parent list so repeated
@@ -259,12 +259,13 @@ def extend(
     base_centers = index.centers[jnp.asarray(uniq)]
     list_data, list_index, list_sizes, list_norms, center_map = _pack_lists(
         all_rows, all_ids, all_labels, len(uniq), index.metric,
-        headroom=getattr(index, "headroom", True),
+        headroom=index.headroom,
     )
     centers = base_centers[jnp.asarray(center_map)]
-    out = Index(index.metric, centers, list_data, list_index, list_sizes, list_norms)
-    out.headroom = getattr(index, "headroom", True)
-    return out
+    return Index(
+        index.metric, centers, list_data, list_index, list_sizes, list_norms,
+        headroom=index.headroom,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n_probes", "k", "metric", "query_tile"))

@@ -145,6 +145,7 @@ class Index:
         self, metric, codebook_kind, pq_bits, centers, centers_rot, rotation,
         codebook, list_codes, list_index, list_sizes, list_data, list_y2,
         scan_scale: float = 1.0,
+        headroom: bool = True,
     ):
         self.metric = metric
         self.codebook_kind = codebook_kind
@@ -163,7 +164,7 @@ class Index:
         # list growth headroom policy (False under
         # conservative_memory_allocation; not serialized — load() defaults
         # True, matching the reference's build-time-only knob)
-        self.headroom = True
+        self.headroom = headroom
 
     @property
     def n_lists(self) -> int:
@@ -452,8 +453,8 @@ def build(
         jnp.zeros((params.n_lists,), jnp.int32),
         jnp.zeros((params.n_lists, 8, rot_dim), dec_dtype),
         jnp.zeros((params.n_lists, 8), jnp.float32),
+        headroom=not params.conservative_memory_allocation,
     )
-    index.headroom = not params.conservative_memory_allocation
     if params.add_data_on_build:
         index = extend(index, dataset, jnp.arange(n, dtype=jnp.int32), res=res)
     _log.debug(
@@ -519,7 +520,7 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
 
     list_codes = np.array(index.list_codes, copy=True)
     list_codes[slab, slots] = codes_np
-    out = Index(
+    return Index(
         index.metric, index.codebook_kind, index.pq_bits,
         index.centers, index.centers_rot, index.rotation, index.codebook,
         list_codes,
@@ -528,9 +529,8 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
         index.list_data.at[lj, sj].set(dec_rows),
         index.list_y2.at[lj, sj].set(y2_rows),
         index.scan_scale,
+        headroom=index.headroom,
     )
-    out.headroom = getattr(index, "headroom", True)
-    return out
 
 
 @traced("ivf_pq.extend")
@@ -606,7 +606,7 @@ def extend(
         all_codes, all_ids, all_labels, len(uniq),
         np.asarray(base_codebook), index.codebook_kind,
         np.asarray(base_centers_rot), index.list_data.dtype,
-        headroom=getattr(index, "headroom", True),
+        headroom=index.headroom,
     )
     cmap_j = jnp.asarray(cmap)
     codebook = (
@@ -614,14 +614,13 @@ def extend(
         if index.codebook_kind == CODEBOOK_PER_CLUSTER
         else index.codebook
     )
-    out = Index(
+    return Index(
         index.metric, index.codebook_kind, index.pq_bits,
         base_centers[cmap_j], base_centers_rot[cmap_j], index.rotation,
         codebook, list_codes, list_index, list_sizes, list_data, list_y2,
         scan_scale,
+        headroom=index.headroom,
     )
-    out.headroom = getattr(index, "headroom", True)
-    return out
 
 
 @functools.partial(

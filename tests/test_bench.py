@@ -96,3 +96,48 @@ def test_plot_writes_png(tmp_path, ds):
     p = str(tmp_path / "f.png")
     plot.plot_results(results, p)
     assert os.path.getsize(p) > 1000
+
+
+class TestDatasetFormats:
+    """Standard ANN interchange formats (ref: raft-ann-bench get_dataset —
+    big-ann .fbin and TEXMEX .fvecs/.ivecs/.bvecs layouts)."""
+
+    def test_vecs_roundtrip(self, rng, tmp_path):
+        from raft_tpu.bench import datasets as D
+
+        f = rng.standard_normal((37, 12)).astype(np.float32)
+        D.write_vecs(str(tmp_path / "a.fvecs"), f)
+        np.testing.assert_array_equal(D.read_vecs(str(tmp_path / "a.fvecs")), f)
+
+        i = rng.integers(0, 1000, (5, 100)).astype(np.int32)
+        D.write_vecs(str(tmp_path / "a.ivecs"), i)
+        np.testing.assert_array_equal(D.read_vecs(str(tmp_path / "a.ivecs")), i)
+
+        b = rng.integers(0, 256, (11, 96)).astype(np.uint8)
+        D.write_vecs(str(tmp_path / "a.bvecs"), b)
+        np.testing.assert_array_equal(D.read_vecs(str(tmp_path / "a.bvecs")), b)
+
+    def test_load_texmex_layout(self, rng, tmp_path):
+        from raft_tpu.bench import datasets as D
+
+        base = rng.standard_normal((200, 16)).astype(np.float32)
+        q = rng.standard_normal((10, 16)).astype(np.float32)
+        gt = rng.integers(0, 200, (10, 5)).astype(np.int32)
+        D.write_vecs(str(tmp_path / "sift_base.fvecs"), base)
+        D.write_vecs(str(tmp_path / "sift_query.fvecs"), q)
+        D.write_vecs(str(tmp_path / "sift_groundtruth.ivecs"), gt)
+        ds = D.load(str(tmp_path))
+        np.testing.assert_array_equal(ds.base, base)
+        np.testing.assert_array_equal(ds.queries, q)
+        np.testing.assert_array_equal(ds.gt_neighbors, gt)
+
+    def test_hdf5_clear_error_without_h5py(self, tmp_path):
+        from raft_tpu.bench import datasets as D
+
+        try:
+            import h5py  # noqa: F401
+            pytest.skip("h5py installed; error path not reachable")
+        except ImportError:
+            pass
+        with pytest.raises(RuntimeError, match="h5py"):
+            D.load_hdf5(str(tmp_path / "x.hdf5"))
