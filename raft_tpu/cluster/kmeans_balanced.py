@@ -140,6 +140,9 @@ def _balanced_iterations(
     return centers, labels
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_clusters", "n_iters", "metric")
+)
 def _fit_flat(
     key: jax.Array,
     x: jax.Array,
