@@ -86,6 +86,11 @@ _DECODED_DTYPES = {
     "int8": jnp.int8,
 }
 
+#: HBM budget for the f32 intermediates of one decode chunk (the decode is
+#: chunked over lists so huge indexes — the int8 mode's reason to exist —
+#: never materialize a full f32 copy of themselves).
+_DECODE_CHUNK_BYTES = 256 << 20
+
 
 @dataclass
 class IndexParams:
@@ -302,30 +307,85 @@ def _decode_lists(
     the reference's fp8 LUT accuracy class, ivf_pq_types.hpp lut_dtype):
     reconstructions are symmetrically quantized with one global scale
     (returned; 1.0 for float dtypes) and the scan runs on the MXU's native
-    int8 path — rot_dim bytes/vector, so DEEP-100M-shape datasets fit HBM."""
+    int8 path — rot_dim bytes/vector, so DEEP-100M-shape datasets fit HBM.
+
+    The decode runs on device: only the codes (pq_dim bytes/vector) and the
+    small codebook/centroid tables cross host→device; the full decoded
+    cache (rot_dim·itemsize bytes/vector) is produced where it lives. It is
+    jitted and chunked over the list axis so the f32 decode intermediates
+    never exceed a fixed HBM budget — the int8 mode exists precisely for
+    indexes whose full f32 decode would not fit."""
     L, cap, pq_dim = list_codes.shape
-    codes = list_codes.astype(np.int64)
-    if codebook_kind == CODEBOOK_PER_SUBSPACE:
-        # cb [j, K, l] → dec [L, cap, j, l]
-        dec = codebook[np.arange(pq_dim)[None, None, :], codes]
-    else:
-        # cb [L, K, l] → dec [L, cap, j, l]
-        dec = codebook[np.arange(L)[:, None, None], codes]
-    y = dec.reshape(L, cap, -1) + centers_rot[:, None, :]
-    y = np.where((list_index >= 0)[..., None], y, 0.0)
+    codes = jnp.asarray(list_codes)
+    cb = jnp.asarray(codebook)
+    cr = jnp.asarray(centers_rot)
+    valid = jnp.asarray(np.asarray(list_index) >= 0)
+    rot_dim = cr.shape[1]
+    per_list = max(1, cap * rot_dim * 4)
+    chunk = int(np.clip(_DECODE_CHUNK_BYTES // per_list, 1, max(L, 1)))
+
+    per_cluster = codebook_kind == CODEBOOK_PER_CLUSTER
+
+    def chunks(extra=None):
+        for s in range(0, L, chunk):
+            cb_c = cb[s : s + chunk] if per_cluster else cb
+            yield (
+                cb_c, cr[s : s + chunk], codes[s : s + chunk],
+                valid[s : s + chunk],
+            ) + (() if extra is None else (extra,))
+
     if dtype == jnp.int8:
-        scale = float(max(np.abs(y).max(), 1e-12)) / 127.0
-        y_int = np.clip(np.rint(y / scale), -127, 127).astype(np.int8)
-        y_f32 = y_int.astype(np.float32) * scale
+        m = 0.0
+        for args in chunks():
+            m = max(m, float(_decode_chunk_absmax(*args, per_cluster)))
+        scale = max(m, 1e-12) / 127.0
+        parts = [
+            _decode_chunk_int8(*args, per_cluster) for args in chunks(scale)
+        ]
         return (
-            jnp.asarray(y_int),
-            jnp.asarray(np.sum(y_f32 * y_f32, axis=-1)),
+            jnp.concatenate([p[0] for p in parts]),
+            jnp.concatenate([p[1] for p in parts]),
             scale,
         )
-    y_stored = jnp.asarray(y.astype(np.float32)).astype(dtype)
+    name = "bfloat16" if dtype == jnp.bfloat16 else "float32"
+    parts = [_decode_chunk_float(*args, per_cluster, name) for args in chunks()]
+    return (
+        jnp.concatenate([p[0] for p in parts]),
+        jnp.concatenate([p[1] for p in parts]),
+        1.0,
+    )
+
+
+def _decode_y(cb, cr, codes, valid, per_cluster: bool):
+    """Decoded f32 reconstructions of one list chunk (traced helper)."""
+    idx = codes.astype(jnp.int32)[..., None, None]
+    if per_cluster:
+        dec = jnp.take_along_axis(cb[:, None, None], idx, axis=3)[..., 0, :]
+    else:
+        dec = jnp.take_along_axis(cb[None, None], idx, axis=3)[..., 0, :]
+    y = dec.reshape(codes.shape[0], codes.shape[1], -1) + cr[:, None, :]
+    return jnp.where(valid[..., None], y, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster",))
+def _decode_chunk_absmax(cb, cr, codes, valid, per_cluster: bool):
+    return jnp.max(jnp.abs(_decode_y(cb, cr, codes, valid, per_cluster)))
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster",))
+def _decode_chunk_int8(cb, cr, codes, valid, scale, per_cluster: bool):
+    y = _decode_y(cb, cr, codes, valid, per_cluster)
+    y_int = jnp.clip(jnp.round(y / scale), -127, 127).astype(jnp.int8)
+    y_f32 = y_int.astype(jnp.float32) * scale
+    return y_int, jnp.sum(y_f32 * y_f32, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster", "dtype_name"))
+def _decode_chunk_float(cb, cr, codes, valid, per_cluster: bool, dtype_name: str):
+    y = _decode_y(cb, cr, codes, valid, per_cluster)
+    y_stored = y.astype(_DECODED_DTYPES[dtype_name])
     y_f32 = y_stored.astype(jnp.float32)
-    y2 = jnp.sum(y_f32 * y_f32, axis=-1)
-    return y_stored, y2, 1.0
+    return y_stored, jnp.sum(y_f32 * y_f32, axis=-1)
 
 
 def _pack_code_lists(
@@ -421,17 +481,19 @@ def build(
     elif params.codebook_kind == CODEBOOK_PER_CLUSTER:
         # pool every subspace slice of a cluster's residuals into one training
         # set per cluster, padded to uniform count with weight-0 rows so the
-        # padding cannot bias the centroids
-        sub = np.asarray(resid).reshape(-1, pq_dim, pq_len)
-        lab = np.asarray(labels)
-        per = [sub[lab == c].reshape(-1, pq_len) for c in range(params.n_lists)]
-        cap = max(max((p.shape[0] for p in per), default=1), k_pq)
+        # padding cannot bias the centroids (one counting-sort scatter, not a
+        # python loop over n_lists)
+        flat = np.asarray(resid).reshape(-1, pq_len)
+        lab2 = np.repeat(np.asarray(labels), pq_dim)
+        counts = np.bincount(lab2, minlength=params.n_lists)
+        cap = max(int(counts.max()) if counts.size else 1, k_pq)
+        order = np.argsort(lab2, kind="stable")
+        starts = np.cumsum(counts) - counts
+        within = np.arange(len(lab2)) - starts[lab2[order]]
         pooled = np.zeros((params.n_lists, cap, pq_len), np.float32)
         wts = np.zeros((params.n_lists, cap), np.float32)
-        for c, p in enumerate(per):
-            if p.shape[0]:
-                pooled[c, : p.shape[0]] = p
-                wts[c, : p.shape[0]] = 1.0
+        pooled[lab2[order], within] = flat[order]
+        wts[lab2[order], within] = 1.0
         codebook = _train_codebooks_lloyd(
             k_cb, jnp.asarray(pooled), k_pq, 25, jnp.asarray(wts)
         )

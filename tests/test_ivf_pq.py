@@ -279,3 +279,23 @@ class TestExtendFastPath:
         # every id present exactly once
         ids = np.asarray(ext.list_index)
         np.testing.assert_array_equal(np.sort(ids[ids >= 0]), np.arange(4000))
+
+
+def test_decode_chunking_matches_single_chunk(data, monkeypatch):
+    """The list-chunked device decode must be invariant to chunk size
+    (regression guard for the HBM-bounded decode path)."""
+    x, q = data
+    params = ivf_pq.IndexParams(
+        n_lists=50, kmeans_n_iters=5, pq_dim=32, pq_bits=8, seed=0,
+        decoded_dtype="int8",
+    )
+    big = ivf_pq.build(params, x)
+    monkeypatch.setattr(ivf_pq, "_DECODE_CHUNK_BYTES", 1 << 16)  # force many chunks
+    small = ivf_pq.build(params, x)
+    assert small.scan_scale == pytest.approx(big.scan_scale)
+    np.testing.assert_array_equal(
+        np.asarray(small.list_data), np.asarray(big.list_data)
+    )
+    np.testing.assert_allclose(
+        np.asarray(small.list_y2), np.asarray(big.list_y2), rtol=1e-6
+    )
