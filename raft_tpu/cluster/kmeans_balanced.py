@@ -30,7 +30,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.resources import Resources, ensure
-from raft_tpu.distance.pairwise import distance_matrix_tile
+from raft_tpu.distance.pairwise import argmin_tile_rows, tiled_argmin
 from raft_tpu.core.trace import traced
 
 
@@ -51,15 +51,16 @@ def _maybe_normalize(x: jax.Array, metric: str) -> jax.Array:
     return x
 
 
-@functools.partial(jax.jit, static_argnames=("metric",))
-def _predict_jit(centers, x, metric: str):
+@functools.partial(jax.jit, static_argnames=("metric", "tile_rows"))
+def _predict_jit(centers, x, metric: str, tile_rows: int):
+    """Normalize + delegate to the shared workspace-tiled fused
+    distance+argmin (pairwise.tiled_argmin — see its DEEP-scale memory
+    rationale; the reference likewise batches predict,
+    cluster/detail/kmeans_balanced.cuh predict's minibatch loop)."""
     x = _maybe_normalize(x.astype(jnp.float32), metric)
     c = _maybe_normalize(centers.astype(jnp.float32), metric)
-    if metric == "inner_product":
-        d = -jnp.matmul(x, c.T, precision=jax.lax.Precision.HIGHEST)
-    else:
-        d = distance_matrix_tile(x, c, "sqeuclidean")
-    return jnp.argmin(d, axis=1).astype(jnp.int32)
+    inner = "inner_product" if metric == "inner_product" else "sqeuclidean"
+    return tiled_argmin(x, c, inner, tile_rows)
 
 
 @traced("kmeans_balanced.predict")
@@ -74,10 +75,17 @@ def predict(
     predict_core :83-164, which uses fusedL2NNMinReduce for L2 and
     pairwise_distance+argmin for other metrics — the metric MUST match the
     one used at build so list membership and probe ranking agree)."""
-    return _predict_jit(jnp.asarray(centers), jnp.asarray(x), metric)
+    res = ensure(res)
+    centers = jnp.asarray(centers)
+    return _predict_jit(
+        centers, jnp.asarray(x), metric,
+        argmin_tile_rows(centers.shape[0], res),
+    )
 
 
-@functools.partial(jax.jit, static_argnames=("n_iters", "n_clusters", "metric"))
+@functools.partial(
+    jax.jit, static_argnames=("n_iters", "n_clusters", "metric", "tile_rows")
+)
 def _balanced_iterations(
     key: jax.Array,
     x: jax.Array,
@@ -86,6 +94,7 @@ def _balanced_iterations(
     n_iters: int,
     n_clusters: int,
     metric: str = "sqeuclidean",
+    tile_rows: int = 1 << 16,
 ):
     """n_iters × (assign → update → adjust_centers).
 
@@ -97,13 +106,11 @@ def _balanced_iterations(
     """
     n = x.shape[0]
     spherical = metric == "cosine"
+    inner = "inner_product" if metric == "inner_product" else "sqeuclidean"
 
     def assign(centers):
-        if metric == "inner_product":
-            d = -jnp.matmul(x, centers.T, precision=jax.lax.Precision.HIGHEST)
-        else:
-            d = distance_matrix_tile(x, centers, "sqeuclidean")
-        return jnp.argmin(d, axis=1).astype(jnp.int32)
+        # shared workspace-tiled fused distance+argmin (pairwise.tiled_argmin)
+        return tiled_argmin(x, centers, inner, tile_rows)
 
     def body(carry, key_i):
         centers = carry
@@ -141,7 +148,7 @@ def _balanced_iterations(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_clusters", "n_iters", "metric")
+    jax.jit, static_argnames=("n_clusters", "n_iters", "metric", "tile_rows")
 )
 def _fit_flat(
     key: jax.Array,
@@ -150,6 +157,7 @@ def _fit_flat(
     n_iters: int,
     weights: jax.Array,
     metric: str = "sqeuclidean",
+    tile_rows: int = 1 << 16,
 ) -> jax.Array:
     k_init, k_iter = jax.random.split(key)
     n = x.shape[0]
@@ -161,7 +169,7 @@ def _fit_flat(
     )
     centers0 = x[idx]
     centers, _ = _balanced_iterations(
-        k_iter, x, centers0, weights, n_iters, n_clusters, metric
+        k_iter, x, centers0, weights, n_iters, n_clusters, metric, tile_rows
     )
     return centers
 
@@ -183,16 +191,21 @@ def fit(
     key = jax.random.PRNGKey(params.seed)
     ones = jnp.ones((n,), jnp.float32)
 
+    tile_rows = argmin_tile_rows(n_clusters, res)
     if n_clusters <= params.mesocluster_threshold or n < 4 * n_clusters:
-        return _fit_flat(key, x, n_clusters, params.n_iters, ones, metric)
+        return _fit_flat(
+            key, x, n_clusters, params.n_iters, ones, metric, tile_rows
+        )
 
     # ---- hierarchical path (ref: build_hierarchical :952) -----------------
     n_meso = int(math.ceil(math.sqrt(n_clusters)))
     k_meso, k_fine, k_final = jax.random.split(key, 3)
-    meso_centers = _fit_flat(k_meso, x, n_meso, params.n_iters, ones, metric)
+    meso_centers = _fit_flat(
+        k_meso, x, n_meso, params.n_iters, ones, metric, tile_rows
+    )
     # x is already normalized for cosine (normalizing again is idempotent),
     # so this assignment matches the training metric
-    meso_labels = np.asarray(predict(meso_centers, x, metric=metric))
+    meso_labels = np.asarray(predict(meso_centers, x, metric=metric, res=res))
 
     # fine cluster budget per mesocluster, proportional to its population;
     # empty mesoclusters get 0 fine clusters (ref: build_fine_clusters :839)
@@ -234,7 +247,9 @@ def fit(
         wts[row, : len(members)] = 1.0
     keys = jax.vmap(lambda m: jax.random.fold_in(k_fine, m))(jnp.asarray(occ))
     vfit = jax.vmap(
-        lambda kk, sub, w: _fit_flat(kk, sub, max_fine, params.n_iters, w, metric)
+        lambda kk, sub, w: _fit_flat(
+            kk, sub, max_fine, params.n_iters, w, metric, tile_rows
+        )
     )
     # chunk the vmap so peak memory stays inside the workspace budget even
     # when one mesocluster holds most of the trainset (member buffer +
@@ -257,7 +272,8 @@ def fit(
 
     # final balancing passes over the full trainset (ref: :1016-1043)
     centers, _ = _balanced_iterations(
-        k_final, x, centers, ones, max(2, params.n_iters // 10), n_clusters, metric
+        k_final, x, centers, ones, max(2, params.n_iters // 10), n_clusters,
+        metric, tile_rows,
     )
     return centers
 

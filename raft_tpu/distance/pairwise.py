@@ -234,6 +234,53 @@ def distance_matrix_tile(
     return _elementwise_tile(x_tile, y, metric, p)
 
 
+def argmin_tile_rows(n_centers: int, res) -> int:
+    """Row-tile size for a fused distance+argmin against ``n_centers``
+    targets, bounded by the resources' workspace budget (the [tile, L] f32
+    score tile is the only distance-matrix memory)."""
+    return int(min(max(res.workspace_rows(4 * max(n_centers, 1)), 8), 1 << 16))
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "tile_rows"))
+def tiled_argmin(x, centers, metric: str, tile_rows: int):
+    """Row-tiled fused distance+argmin: labels [n] int32.
+
+    The shared building block for kmeans predict/fit assignment (the
+    fusedL2NNMinReduce role, ref distance/fused_l2_nn-inl.cuh): only a
+    [tile_rows, L] score tile is ever materialized, and ``x`` is consumed
+    through slices (no padded copy — a full [n, L] matrix is ~200 GB at
+    DEEP-scale n × 50k lists). ``metric`` is "sqeuclidean" or
+    "inner_product"; normalize beforehand for cosine.
+
+    Related fused-argmin variants: distance/fused_nn.py returns
+    (min_dist, argmin) via a padded row-tile scan, and
+    kernels/fused_argmin.py is the Pallas candidate for the same role —
+    this is the labels-only, slice-tailed variant the kmeans loops use.
+    """
+
+    def score_argmin(t):
+        if metric == "inner_product":
+            d = -jnp.matmul(t, centers.T, precision=lax.Precision.HIGHEST)
+        else:
+            d = distance_matrix_tile(t, centers, "sqeuclidean")
+        return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+    n = x.shape[0]
+    tile_rows = min(tile_rows, n)
+    if n <= tile_rows:
+        return score_argmin(x)
+    n_full = (n // tile_rows) * tile_rows
+    main = lax.map(
+        score_argmin, x[:n_full].reshape(-1, tile_rows, x.shape[1])
+    ).reshape(n_full)
+    if n_full == n:
+        return main
+    # final partial tile: score the last tile_rows rows (a static slice —
+    # cheaper than padding a copy of all of x) and keep the new suffix
+    tail = score_argmin(x[n - tile_rows:])
+    return jnp.concatenate([main, tail[tile_rows - (n - n_full):]])
+
+
 @functools.partial(jax.jit, static_argnames=("metric", "tile_rows"))
 def _pairwise_jit(x, y, metric: str, p: float, tile_rows: int):
     m = x.shape[0]

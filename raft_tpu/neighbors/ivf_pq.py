@@ -334,26 +334,43 @@ def _decode_lists(
                 valid[s : s + chunk],
             ) + (() if extra is None else (extra,))
 
+    def assemble(part_iter, out_dtype):
+        """Write decoded chunks into preallocated (donated) buffers so peak
+        HBM is one final cache + one chunk, never 2× (the concatenate of a
+        parts list doubles residency exactly on the just-fits indexes the
+        int8 mode exists for)."""
+        data = jnp.zeros((L, cap, rot_dim), out_dtype)
+        y2 = jnp.zeros((L, cap), jnp.float32)
+        s = 0
+        for part_d, part_y2 in part_iter:
+            data = _write_rows(data, part_d, s)
+            y2 = _write_rows(y2, part_y2, s)
+            s += part_d.shape[0]
+        return data, y2
+
     if dtype == jnp.int8:
         m = 0.0
         for args in chunks():
             m = max(m, float(_decode_chunk_absmax(*args, per_cluster)))
         scale = max(m, 1e-12) / 127.0
-        parts = [
-            _decode_chunk_int8(*args, per_cluster) for args in chunks(scale)
-        ]
-        return (
-            jnp.concatenate([p[0] for p in parts]),
-            jnp.concatenate([p[1] for p in parts]),
-            scale,
+        data, y2 = assemble(
+            (_decode_chunk_int8(*args, per_cluster) for args in chunks(scale)),
+            jnp.int8,
         )
+        return data, y2, scale
     name = "bfloat16" if dtype == jnp.bfloat16 else "float32"
-    parts = [_decode_chunk_float(*args, per_cluster, name) for args in chunks()]
-    return (
-        jnp.concatenate([p[0] for p in parts]),
-        jnp.concatenate([p[1] for p in parts]),
-        1.0,
+    data, y2 = assemble(
+        (_decode_chunk_float(*args, per_cluster, name) for args in chunks()),
+        dtype,
     )
+    return data, y2, 1.0
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_rows(buf, part, start):
+    """Donated in-place row-block write (start is traced → one compiled
+    program regardless of chunk count)."""
+    return lax.dynamic_update_slice_in_dim(buf, part, start, axis=0)
 
 
 def _decode_y(cb, cr, codes, valid, per_cluster: bool):
