@@ -65,6 +65,47 @@ def probe_tpu() -> str | None:
     return None
 
 
+# importing raft_tpu applies the exact production cache config (package
+# default dir + RAFT_TPU_CACHE_DIR / JAX_COMPILATION_CACHE_DIR overrides)
+_CACHE_PROBE_SRC = (
+    "import raft_tpu, jax, jax.numpy as jnp, numpy as np; "
+    "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0); "
+    "x = jnp.ones((256, 256), jnp.float32); "
+    "print('CACHE_OK', float(np.asarray((x @ x + 1.0).sum())))"
+)
+
+
+def probe_compile_cache() -> bool:
+    """Verify the persistent XLA compile cache round-trips against the live
+    backend: one pass populates the cache (executable *serialization* —
+    never validated over the axon tunnel), a second pass in a fresh process
+    hits the entries (*deserialization* — the path a warm bench rerun
+    takes). A hang in either must not take down the bench. Retries once per
+    pass for tunnel flakiness (mirrors probe_tpu's retry rationale)."""
+    for phase in ("write", "read"):
+        for attempt in range(2):
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-c", _CACHE_PROBE_SRC],
+                    capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+                )
+                if out.returncode == 0 and "CACHE_OK" in out.stdout:
+                    break
+                err = (out.stderr or out.stdout).strip().splitlines()
+                print(f"cache probe ({phase}) attempt {attempt + 1}: "
+                      f"rc={out.returncode} {err[-1] if err else ''}",
+                      file=sys.stderr)
+            except subprocess.TimeoutExpired:
+                print(f"cache probe ({phase}) attempt {attempt + 1}: timeout "
+                      f"after {PROBE_TIMEOUT_S}s", file=sys.stderr)
+            if attempt == 1:
+                print(f"disabling persistent compile cache (failed {phase} "
+                      "pass)", file=sys.stderr)
+                return False
+            time.sleep(PROBE_BACKOFF_S)
+    return True
+
+
 def timeit(fn, *args, warmup=2, iters=5):
     import jax
 
@@ -79,6 +120,11 @@ def timeit(fn, *args, warmup=2, iters=5):
 
 def main() -> None:
     platform = probe_tpu()
+    # CPU-only cache writes are proven safe; with a live accelerator,
+    # verify cache serialization in a subprocess first — an unverified/
+    # broken cache must never hang the bench.
+    if platform is not None and not probe_compile_cache():
+        os.environ["RAFT_TPU_NO_COMPILE_CACHE"] = "1"
     import jax
 
     if platform is None:
