@@ -28,7 +28,7 @@ import sys
 import time
 
 PROBE_TIMEOUT_S = 150
-PROBE_RETRIES = 3
+PROBE_RETRIES = 2
 PROBE_BACKOFF_S = 10
 
 _PROBE_SRC = (
@@ -144,7 +144,7 @@ def main() -> None:
     if on_accel:
         n, d, n_q, k = 100_000, 96, 10_000, 10
     else:
-        n, d, n_q, k = 20_000, 96, 500, 10
+        n, d, n_q, k = 12_000, 96, 300, 10
 
     # Clustered synthetic data (mixture of gaussians): real ANN corpora
     # (DEEP/SIFT embeddings) are clustered, and the reference's tests build
