@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the axon TPU tunnel; exit 0 as soon as a real TPU backend responds.
+for i in $(seq 1 200); do
+  if timeout 70 python -c "
+import subprocess, sys
+r = subprocess.run([sys.executable, '-c', 'import jax; d=jax.devices(); assert d[0].platform==\"tpu\", d; print(\"TPU-ALIVE\", d)'], capture_output=True, text=True, timeout=60)
+sys.exit(0 if (r.returncode==0 and 'TPU-ALIVE' in r.stdout) else 1)
+" 2>/dev/null; then
+    echo "TPU ALIVE at $(date)"
+    exit 0
+  fi
+  echo "probe $i dead at $(date)"
+  sleep 180
+done
+echo "gave up after 200 probes"
+exit 1

@@ -61,8 +61,14 @@ def _enable_persistent_compile_cache() -> None:
             return  # ditto for an in-process jax.config setting
     except AttributeError:
         pass
+    # default to a user cache dir (XDG), never inside the installed package:
+    # a pip install lands alongside site-packages, which may be read-only and
+    # shouldn't accumulate state
+    xdg = _os.environ.get("XDG_CACHE_HOME") or _os.path.join(
+        _os.path.expanduser("~"), ".cache"
+    )
     cache_dir = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.join(
-        _os.path.dirname(_os.path.abspath(__file__)), _os.pardir, ".jax_cache"
+        xdg, "raft_tpu", "jax_cache"
     )
     try:
         _os.makedirs(cache_dir, exist_ok=True)

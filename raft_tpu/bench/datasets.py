@@ -97,8 +97,12 @@ def load_hdf5(path: str, name: str = "") -> "Dataset":
             "layout (write_bin) on a machine that has it"
         ) from e
     with h5py.File(path, "r") as f:  # pragma: no cover - h5py not in image
+        # h5py string attrs may come back as bytes (fixed-length storage)
+        dist = f.attrs.get("distance", "euclidean")
+        if isinstance(dist, bytes):
+            dist = dist.decode()
         metric = {"euclidean": "sqeuclidean", "angular": "cosine"}.get(
-            f.attrs.get("distance", "euclidean"), "sqeuclidean"
+            dist, "sqeuclidean"
         )
         ds = Dataset(
             name=name or os.path.basename(path),

@@ -90,7 +90,65 @@ def subsample_trainset(dataset, n_train: int, seed: int):
 
     n = dataset.shape[0]
     idx = np.random.default_rng(seed).choice(n, size=n_train, replace=False)
+    if isinstance(dataset, np.ndarray):
+        # host dataset (possibly a memmap): gather host-side, upload only
+        # the trainset rows
+        return _jnp.asarray(dataset[np.sort(idx)])
     return dataset[_jnp.asarray(np.sort(idx))]
+
+
+def compute_list_layout(
+    labels: np.ndarray,
+    n_lists: int,
+    max_cap: Optional[int] = None,
+    headroom: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """Per-row (list, slot) placement for the padded list layout — metadata
+    only, no payload touched (so callers can stream the payload scatter
+    device-side in bounded chunks instead of materializing padded host
+    arrays; the 100M-scale path).
+
+    Returns (lst [n], slot [n], sizes [n_lists'], center_map [n_lists'],
+    cap). cap is the max list size rounded up to the sublane multiple (8) —
+    plus ~12.5% growth headroom when ``headroom`` is set, so even the
+    fullest list keeps spare slots and in-place extends
+    (allocate_append_slots) don't immediately fall back to a repack. With
+    ``max_cap`` set, oversized lists are split (split_oversized_lists) so
+    cap ≤ round_up(max_cap, 8) regardless of cluster skew; center_map tells
+    the caller how to expand its centroid rows."""
+    from raft_tpu.core import native
+
+    def with_headroom(base: int) -> int:
+        cap = base + max(8, base // 8) if headroom else base
+        cap = max(8, round_up(cap, 8))
+        if max_cap is not None:
+            cap = min(cap, round_up(max_cap, 8))
+        return max(cap, round_up(max(base, 1), 8))  # never below actual max
+
+    labels = np.asarray(labels, np.int64)
+    n = labels.shape[0]
+    if max_cap is not None and n and native.available():
+        # native layout pass (threads/split logic in C++)
+        slot, lst, center_map, cap = native.pack_list_layout(
+            labels, n_lists, max_cap
+        )
+        cap = with_headroom(cap)
+        sizes = np.bincount(lst, minlength=len(center_map)).astype(np.int32)
+        return lst, slot, sizes, center_map, cap
+
+    if max_cap is not None:
+        labels, center_map = split_oversized_lists(labels, n_lists, max_cap)
+        n_lists = len(center_map)
+    else:
+        center_map = np.arange(n_lists, dtype=np.int64)
+    sizes = np.bincount(labels, minlength=n_lists)
+    cap = with_headroom(int(sizes.max()) if n else 8)
+    order = np.argsort(labels, kind="stable")
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    slot = np.empty(n, np.int64)
+    slot[order] = np.arange(n) - starts[labels[order]]
+    return labels, slot, sizes.astype(np.int32), center_map, cap
 
 
 def pack_padded_lists(
@@ -104,57 +162,19 @@ def pack_padded_lists(
     """Scatter rows into the padded [n_lists', cap, ...] layout (host-side;
     the analog of the reference's per-list code/vector packing,
     ivf_flat_build.cuh:88-154). Returns (list_payload, list_index, sizes,
-    center_map); cap is the max list size rounded up to the sublane
-    multiple (8) — plus ~12.5% growth headroom when ``headroom`` is set, so
-    even the fullest list keeps spare slots and in-place extends
-    (allocate_append_slots) don't immediately fall back to a repack (pass
-    it only for extendable indexes: static ones would scan the padding on
-    every query for nothing). With ``max_cap`` set, oversized lists are
-    split (see split_oversized_lists) so cap ≤ round_up(max_cap, 8)
-    regardless of cluster skew; center_map tells the caller how to expand
-    its centroid rows (identity when nothing split)."""
-    from raft_tpu.core import native
-
-    def with_headroom(base: int) -> int:
-        cap = base + max(8, base // 8) if headroom else base
-        cap = max(8, round_up(cap, 8))
-        if max_cap is not None:
-            cap = min(cap, round_up(max_cap, 8))
-        return max(cap, round_up(max(base, 1), 8))  # never below actual max
-
-    n = payload.shape[0]
-    labels = np.asarray(labels, np.int64)
-    if max_cap is not None and n and native.available():
-        # native layout pass (threads/split logic in C++; the payload
-        # scatter — pure memcpy — stays in numpy fancy indexing)
-        slot, lst, center_map, cap = native.pack_list_layout(
-            labels, n_lists, max_cap
-        )
-        cap = with_headroom(cap)
-        n_lists = len(center_map)
-        list_payload = np.zeros((n_lists, cap) + payload.shape[1:], payload.dtype)
-        list_index = np.full((n_lists, cap), -1, np.int32)
-        list_payload[lst, slot] = payload
-        list_index[lst, slot] = ids
-        sizes = np.bincount(lst, minlength=n_lists)
-        return list_payload, list_index, sizes.astype(np.int32), center_map
-
-    if max_cap is not None:
-        labels, center_map = split_oversized_lists(labels, n_lists, max_cap)
-        n_lists = len(center_map)
-    else:
-        center_map = np.arange(n_lists, dtype=np.int64)
-    sizes = np.bincount(labels, minlength=n_lists)
-    cap = with_headroom(int(sizes.max()) if n else 8)
+    center_map). Layout policy (headroom / skew splitting) lives in
+    compute_list_layout; the payload scatter here is numpy fancy indexing —
+    use compute_list_layout directly + device scatters for datasets too big
+    to duplicate host-side."""
+    lst, slot, sizes, center_map, cap = compute_list_layout(
+        labels, n_lists, max_cap=max_cap, headroom=headroom
+    )
+    n_lists = len(center_map)
     list_payload = np.zeros((n_lists, cap) + payload.shape[1:], payload.dtype)
     list_index = np.full((n_lists, cap), -1, np.int32)
-    order = np.argsort(labels, kind="stable")
-    starts = np.zeros(n_lists + 1, np.int64)
-    np.cumsum(sizes, out=starts[1:])
-    within = np.arange(n) - starts[labels[order]]
-    list_payload[labels[order], within] = payload[order]
-    list_index[labels[order], within] = ids[order]
-    return list_payload, list_index, sizes.astype(np.int32), center_map
+    list_payload[lst, slot] = payload
+    list_index[lst, slot] = ids
+    return list_payload, list_index, sizes, center_map
 
 
 def unpack_lists(
@@ -214,7 +234,15 @@ def invalid_mask(ids: jax.Array, filter_words: Optional[jax.Array]) -> jax.Array
     return invalid
 
 
-def allocate_append_slots(centers, list_sizes, cap, labels):
+def centroid_group_inverse(centers) -> np.ndarray:
+    """Group id per list, where split shards of one oversized list (which
+    duplicate their parent centroid, see split_oversized_lists) share a
+    group. O(L·dim) — cache the result on the index for repeated appends."""
+    _, inverse = np.unique(np.asarray(centers), axis=0, return_inverse=True)
+    return inverse
+
+
+def allocate_append_slots(centers, list_sizes, cap, labels, group_inverse=None):
     """Assign a (list, slot) to each new row for an in-place append, or
     return None when a centroid group is out of spare capacity.
 
@@ -225,6 +253,9 @@ def allocate_append_slots(centers, list_sizes, cap, labels):
     IVF-Flat/IVF-PQ fast extend paths (the TPU answer to the reference's
     device-side list growth, ivf_flat_build.cuh:163 / ivf_pq_build.cuh:1501).
 
+    ``group_inverse`` — pass ``centroid_group_inverse(centers)`` cached by
+    the caller to skip the O(L·dim) dedupe on every incremental append.
+
     Returns (lists [n], slots [n], counts_new [L]) — all numpy — or None.
     """
     centers = np.asarray(centers)
@@ -234,7 +265,11 @@ def allocate_append_slots(centers, list_sizes, cap, labels):
     if labels.size and labels.max() >= L:
         return None
 
-    _, inverse = np.unique(centers, axis=0, return_inverse=True)
+    inverse = (
+        group_inverse
+        if group_inverse is not None
+        else centroid_group_inverse(centers)
+    )
     group_members: dict = {}
     for lst, g in enumerate(inverse):
         group_members.setdefault(int(g), []).append(lst)

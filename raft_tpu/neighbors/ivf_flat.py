@@ -41,6 +41,7 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
     allocate_append_slots,
+    centroid_group_inverse,
     subsample_trainset,
     coarse_select,
     invalid_mask,
@@ -98,8 +99,11 @@ class Index:
         self.list_sizes = list_sizes
         self.list_norms = list_norms
         # list growth headroom policy (False under
-        # conservative_memory_allocation; not serialized)
+        # conservative_memory_allocation; serialized like the reference's
+        # conservative_memory_allocation flag, ivf_flat_serialize.cuh:66)
         self.headroom = headroom
+        # cached centroid→group map for repeated fast appends (derived)
+        self._group_inverse = None
 
     @property
     def n_lists(self) -> int:
@@ -225,14 +229,17 @@ def extend(
     # (the TPU answer to the reference's device-side list growth,
     # detail/ivf_flat_build.cuh:163; shard-aware — see allocate_append_slots)
     if new_vectors.shape[0] and old_n:
+        if index._group_inverse is None:
+            index._group_inverse = centroid_group_inverse(index.centers)
         alloc = allocate_append_slots(
-            index.centers, index.list_sizes, index.list_cap, np.asarray(labels)
+            index.centers, index.list_sizes, index.list_cap,
+            np.asarray(labels), group_inverse=index._group_inverse,
         )
         if alloc is not None:
             slab, slots, counts_new = alloc
             lj, sj = jnp.asarray(slab), jnp.asarray(slots)
             rows32 = new_vectors.astype(jnp.float32)
-            return Index(
+            new = Index(
                 index.metric,
                 index.centers,
                 index.list_data.at[lj, sj].set(new_vectors),
@@ -245,6 +252,8 @@ def extend(
                 ),
                 headroom=index.headroom,
             )
+            new._group_inverse = index._group_inverse
+            return new
 
     # merge with existing content host-side, then re-pack; split shards from
     # a previous pack are first merged back to their parent list so repeated
@@ -378,7 +387,9 @@ def save(filename: str, index: Index) -> None:
         filename,
         "ivf_flat",
         _SERIALIZATION_VERSION,
-        {"metric": index.metric},
+        # ref serializes conservative_memory_allocation
+        # (ivf_flat_serialize.cuh:66); headroom == not conservative
+        {"metric": index.metric, "headroom": int(index.headroom)},
         {
             "centers": index.centers,
             "list_data": index.list_data,
@@ -398,4 +409,5 @@ def load(filename: str) -> Index:
         jnp.asarray(arrays["list_index"]),
         jnp.asarray(arrays["list_sizes"]),
         jnp.asarray(arrays["list_norms"]),
+        headroom=bool(scalars.get("headroom", 1)),
     )

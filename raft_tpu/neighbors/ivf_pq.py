@@ -60,12 +60,13 @@ from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
     allocate_append_slots,
+    centroid_group_inverse,
+    compute_list_layout,
     subsample_trainset,
     coarse_select,
     default_max_cap,
     invalid_mask,
     merge_split_lists,
-    pack_padded_lists,
     unpack_lists,
 )
 from raft_tpu.ops.matrix import select_k
@@ -85,6 +86,29 @@ _DECODED_DTYPES = {
     "float32": jnp.float32,
     "int8": jnp.int8,
 }
+
+#: fraction of device memory the scan cache may claim before "auto"
+#: downgrades bf16 → int8 (leaves room for queries, probe gathers, and the
+#: decode chunk)
+_AUTO_HBM_FRACTION = 0.55
+
+
+def _device_memory_budget() -> int:
+    """Bytes of accelerator memory to plan against. Real limit where the
+    backend reports one (TPU/GPU ``memory_stats``); `RAFT_TPU_HBM_BYTES`
+    overrides; 16 GiB (one v5e chip) when unknown (e.g. CPU)."""
+    import os
+
+    env = os.environ.get("RAFT_TPU_HBM_BYTES")
+    if env:
+        return int(env)
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 << 30
 
 #: HBM budget for the f32 intermediates of one decode chunk (the decode is
 #: chunked over lists so huge indexes — the int8 mode's reason to exist —
@@ -109,8 +133,12 @@ class IndexParams:
     seed: int = 0
     # dtype of the decoded scan cache (the fp16-LUT accuracy-class analog,
     # ref search_params::lut_dtype ivf_pq_types.hpp:139-172): "bfloat16"
-    # halves scan HBM traffic; "float32" is exact decode.
-    decoded_dtype: str = "bfloat16"
+    # halves scan HBM traffic; "float32" is exact decode; "int8" is the
+    # memory-lean quantized cache (rot_dim B/vector). "auto" (default)
+    # picks bf16 unless the projected index footprint exceeds the device
+    # memory budget (_device_memory_budget), then drops to int8 — so
+    # DEEP-100M-shape builds fit a 16 GB chip without manual tuning.
+    decoded_dtype: str = "auto"
 
 
 @dataclass
@@ -167,9 +195,11 @@ class Index:
         # dequantization scale of an int8 scan cache (1.0 for float caches)
         self.scan_scale = scan_scale
         # list growth headroom policy (False under
-        # conservative_memory_allocation; not serialized — load() defaults
-        # True, matching the reference's build-time-only knob)
+        # conservative_memory_allocation; serialized like the reference's
+        # conservative_memory_allocation flag, ivf_pq_serialize.cuh:64)
         self.headroom = headroom
+        # cached centroid→group map for repeated fast appends (derived)
+        self._group_inverse = None
 
     @property
     def n_lists(self) -> int:
@@ -405,7 +435,61 @@ def _decode_chunk_float(cb, cr, codes, valid, per_cluster: bool, dtype_name: str
     return y_stored, jnp.sum(y_f32 * y_f32, axis=-1)
 
 
-def _pack_code_lists(
+def _rows_y(cb, cr, codes, labels, per_cluster: bool):
+    """f32 reconstructions of a row chunk: y = cr[label] + decode(codes).
+    Shared by the streamed assemble, the fast-append decode, and absmax
+    scans (traced helper; OOB labels clamp-gather — callers mask/drop)."""
+    codes_i = codes.astype(jnp.int32)
+    if per_cluster:
+        b = cb[labels]  # [n, K, l]
+        dec = jnp.take_along_axis(b, codes_i[:, :, None], axis=1)
+    else:
+        dec = jnp.take_along_axis(
+            cb[None], codes_i[:, :, None, None], axis=2
+        )[:, :, 0, :]
+    return dec.reshape(codes.shape[0], -1) + cr[labels]
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster",))
+def _rows_absmax(cb, cr, codes, labels, valid, per_cluster: bool):
+    y = _rows_y(cb, cr, codes, labels, per_cluster)
+    return jnp.max(jnp.where(valid[:, None], jnp.abs(y), 0.0))
+
+
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2, 3),
+    static_argnames=("per_cluster",),
+)
+def _scatter_chunk(
+    l_codes, l_index, l_data, l_y2,  # donated [L, cap, ...] buffers
+    cb, cr, codes, ids, lst, slot, scale,
+    per_cluster: bool,
+):
+    """Decode one row chunk and scatter it into the padded device buffers.
+
+    Padding rows in the (fixed-size) last chunk carry lst == n_lists —
+    out of bounds, so ``mode="drop"`` discards them; gather clamping on the
+    decode side is harmless for dropped rows. Donation keeps peak HBM at
+    one index + one chunk (the streamed analog of the reference's batched
+    device-side extend, ivf_pq_build.cuh:1374-1460)."""
+    y = _rows_y(cb, cr, codes, lst, per_cluster)
+    if l_data.dtype == jnp.int8:
+        stored = jnp.clip(jnp.round(y / scale), -127, 127).astype(jnp.int8)
+        y_f32 = stored.astype(jnp.float32) * scale
+    else:
+        stored = y.astype(l_data.dtype)
+        y_f32 = stored.astype(jnp.float32)
+    y2 = jnp.sum(y_f32 * y_f32, axis=-1)
+    return (
+        l_codes.at[lst, slot].set(codes, mode="drop"),
+        l_index.at[lst, slot].set(ids, mode="drop"),
+        l_data.at[lst, slot].set(stored, mode="drop"),
+        l_y2.at[lst, slot].set(y2, mode="drop"),
+    )
+
+
+def _assemble_lists(
     codes: np.ndarray,
     ids: np.ndarray,
     labels: np.ndarray,
@@ -416,29 +500,96 @@ def _pack_code_lists(
     dtype,
     headroom: bool = True,
 ):
-    """Scatter encoded rows into the padded [n_lists', cap, pq_dim] layout
-    and build the decoded scan cache. Oversized lists are split with
-    duplicated centroids (skew-bounded cap; _common.split_oversized_lists);
-    returns center_map for the caller to expand centers/codebooks."""
-    list_codes, list_index, sizes, center_map = pack_padded_lists(
-        codes, ids, labels, n_lists,
-        max_cap=default_max_cap(codes.shape[0], n_lists),
+    """Streamed device-side list assembly: compute the (list, slot) layout
+    host-side (metadata only — O(n) ints, no padded payload copies), then
+    decode + scatter row chunks into preallocated, donated device buffers.
+
+    Host residency is bounded by the compressed stream (codes pq_dim B/row
+    + labels/ids 8 B/row); device residency by the final index + one
+    decode chunk. This replaces the old pack-then-decode path whose padded
+    host arrays and full-index transfers could not survive 10⁸ rows
+    (ref: batched extend ivf_pq_build.cuh:1374-1501). Oversized lists are
+    split with duplicated centroids (skew-bounded cap;
+    _common.split_oversized_lists); returns center_map for the caller to
+    expand centers/codebooks."""
+    n, pq_dim = codes.shape
+    lst, slot, sizes, center_map, cap = compute_list_layout(
+        labels, n_lists,
+        max_cap=default_max_cap(n, n_lists),
         headroom=headroom,
     )
+    L = len(center_map)
     centers_rot = np.asarray(centers_rot)[center_map]
     if codebook_kind == CODEBOOK_PER_CLUSTER:
         codebook = np.asarray(codebook)[center_map]
-    list_data, list_y2, scan_scale = _decode_lists(
-        codebook, codebook_kind, centers_rot, list_codes, list_index, dtype
-    )
+    per_cluster = codebook_kind == CODEBOOK_PER_CLUSTER
+    rot_dim = centers_rot.shape[1]
+    cb = jnp.asarray(codebook)
+    cr = jnp.asarray(centers_rot)
+
+    # fixed chunk size → every chunk reuses one compiled scatter program;
+    # bound the f32 decode intermediates (y, dec, stored) + the per-cluster
+    # codebook gather to the decode HBM budget
+    per_row = rot_dim * 4 * 4
+    if per_cluster:
+        per_row += codebook.shape[1] * codebook.shape[2] * 4
+    chunk = int(np.clip(_DECODE_CHUNK_BYTES // max(per_row, 1), 8, max(n, 8)))
+
+    codes = np.ascontiguousarray(np.asarray(codes, np.uint8))
+    ids = np.asarray(ids, np.int32)
+    lst32 = np.asarray(lst, np.int32)
+    slot32 = np.asarray(slot, np.int32)
+
+    def chunk_codes(s):
+        e = min(s + chunk, n)
+        pad = chunk - (e - s)
+        c = codes[s:e]
+        l = lst32[s:e]
+        if pad:
+            c = np.concatenate([c, np.zeros((pad, pq_dim), np.uint8)])
+            # padding rows point past the last list → scatter mode="drop"
+            l = np.concatenate([l, np.full(pad, L, np.int32)])
+        return jnp.asarray(c), jnp.asarray(l)
+
+    def chunk_meta(s):
+        e = min(s + chunk, n)
+        pad = chunk - (e - s)
+        i = ids[s:e]
+        sl = slot32[s:e]
+        if pad:
+            i = np.concatenate([i, np.zeros(pad, np.int32)])
+            sl = np.concatenate([sl, np.zeros(pad, np.int32)])
+        return jnp.asarray(i), jnp.asarray(sl)
+
+    scale = 1.0
+    if dtype == jnp.int8:
+        # scale pre-pass streams only codes+list ids (ids/slots are not
+        # consumed until the scatter pass — keep them off the wire here)
+        m = 0.0
+        for s in range(0, max(n, 1), chunk):
+            c, l = chunk_codes(s)
+            m = max(m, float(_rows_absmax(cb, cr, c, l, l < L, per_cluster)))
+        scale = max(m, 1e-12) / 127.0
+
+    l_codes = jnp.zeros((L, cap, pq_dim), jnp.uint8)
+    l_index = jnp.full((L, cap), -1, jnp.int32)
+    l_data = jnp.zeros((L, cap, rot_dim), dtype)
+    l_y2 = jnp.zeros((L, cap), jnp.float32)
+    for s in range(0, n, chunk):
+        c, l = chunk_codes(s)
+        i, sl = chunk_meta(s)
+        l_codes, l_index, l_data, l_y2 = _scatter_chunk(
+            l_codes, l_index, l_data, l_y2,
+            cb, cr, c, i, l, sl, jnp.float32(scale), per_cluster,
+        )
     return (
-        list_codes,
-        jnp.asarray(list_index),
+        l_codes,
+        l_index,
         jnp.asarray(sizes),
-        list_data,
-        list_y2,
+        l_data,
+        l_y2,
         center_map,
-        scan_scale,
+        scale,
     )
 
 
@@ -449,9 +600,16 @@ def build(
     *,
     res: Optional[Resources] = None,
 ) -> Index:
-    """(ref: build pipeline detail/ivf_pq_build.cuh:1681-1836)"""
+    """(ref: build pipeline detail/ivf_pq_build.cuh:1681-1836)
+
+    ``dataset`` may be a host numpy array (including a memmap): it is never
+    uploaded wholesale — the trainset subsample and the per-tile
+    predict+encode stream are the only device transfers, so datasets far
+    larger than HBM build on one chip (the out-of-core intent of the
+    reference's deep-100M/wiki-all configs, docs/source/wiki_all_dataset.md)."""
     res = ensure(res)
-    dataset = jnp.asarray(dataset)
+    if not isinstance(dataset, np.ndarray):
+        dataset = jnp.asarray(dataset)
     n, dim = dataset.shape
     canonical = DISTANCE_TYPES[params.metric]
     if canonical not in ("sqeuclidean", "euclidean", "inner_product"):
@@ -499,26 +657,46 @@ def build(
         # pool every subspace slice of a cluster's residuals into one training
         # set per cluster, padded to uniform count with weight-0 rows so the
         # padding cannot bias the centroids (one counting-sort scatter, not a
-        # python loop over n_lists)
+        # python loop over n_lists). The pooled cap is bounded: the [L, cap,
+        # pq_len] allocation scales with the most skewed cluster, and a
+        # k_pq-center Lloyd gains nothing past a few thousand samples — rows
+        # beyond the cap are dropped (uniform within-cluster subsample via
+        # the trainset's row order, itself a random draw).
         flat = np.asarray(resid).reshape(-1, pq_len)
         lab2 = np.repeat(np.asarray(labels), pq_dim)
         counts = np.bincount(lab2, minlength=params.n_lists)
         cap = max(int(counts.max()) if counts.size else 1, k_pq)
+        cap = min(cap, max(8 * k_pq, 2048))
         order = np.argsort(lab2, kind="stable")
         starts = np.cumsum(counts) - counts
         within = np.arange(len(lab2)) - starts[lab2[order]]
+        keep = within < cap
         pooled = np.zeros((params.n_lists, cap, pq_len), np.float32)
         wts = np.zeros((params.n_lists, cap), np.float32)
-        pooled[lab2[order], within] = flat[order]
-        wts[lab2[order], within] = 1.0
+        pooled[lab2[order][keep], within[keep]] = flat[order][keep]
+        wts[lab2[order][keep], within[keep]] = 1.0
         codebook = _train_codebooks_lloyd(
             k_cb, jnp.asarray(pooled), k_pq, 25, jnp.asarray(wts)
         )
     else:
         raise ValueError(f"unknown codebook_kind {params.codebook_kind}")
 
-    validation.check_in(params.decoded_dtype, _DECODED_DTYPES, "decoded_dtype")
-    dec_dtype = _DECODED_DTYPES[params.decoded_dtype]
+    decoded_dtype = params.decoded_dtype
+    if decoded_dtype == "auto":
+        # projected footprint at bf16: padded rows × (scan cache + codes +
+        # y2 + ids); 1.35 ≈ split/headroom padding allowance
+        est_rows = int(n * 1.35) + 8 * params.n_lists
+        bf16_bytes = est_rows * (rot_dim * 2 + pq_dim + 8)
+        budget = int(_AUTO_HBM_FRACTION * _device_memory_budget())
+        decoded_dtype = "bfloat16" if bf16_bytes <= budget else "int8"
+        if decoded_dtype == "int8":
+            _log.info(
+                "ivf_pq.build: projected bf16 cache %.1f GB exceeds %.1f GB "
+                "budget — auto-selecting int8 scan cache",
+                bf16_bytes / 2**30, budget / 2**30,
+            )
+    validation.check_in(decoded_dtype, _DECODED_DTYPES, "decoded_dtype")
+    dec_dtype = _DECODED_DTYPES[decoded_dtype]
     index = Index(
         params.metric,
         params.codebook_kind,
@@ -546,30 +724,26 @@ def build(
 
 
 def _decode_rows(index: Index, codes: jax.Array, labels: jax.Array):
-    """Decode encoded rows → (stored-dtype rows [n, rot_dim], y2 [n]) using
-    the index's scan-cache dtype (+frozen int8 scale). Device-side; the
-    per-row analog of the host _decode_lists pass."""
-    pq_dim = index.pq_dim
-    codes_i = codes.astype(jnp.int32)
-    if index.codebook_kind == CODEBOOK_PER_SUBSPACE:
-        dec = jnp.take_along_axis(
-            index.codebook[None],  # [1, j, K, l]
-            codes_i[:, :, None, None],  # [n, j, 1, 1]
-            axis=2,
-        )[:, :, 0, :]  # [n, j, l]
-    else:
-        cb = index.codebook[labels]  # [n, K, l] per-cluster books
-        dec = jnp.take_along_axis(cb, codes_i[:, :, None], axis=1)
-    y = dec.reshape(codes.shape[0], -1) + index.centers_rot[labels]
+    """Decode encoded rows → (stored-dtype rows [n, rot_dim], y2 [n],
+    absmax scalar f32) using the index's scan-cache dtype (+frozen int8
+    scale). Device-side; the per-row analog of the host _decode_lists pass.
+    ``absmax`` is the pre-quantization |y| peak — callers appending into an
+    int8 cache must compare it against 127·scan_scale and take the
+    repack/rescale path instead when quantizing would clip."""
+    y = _rows_y(
+        index.codebook, index.centers_rot, codes, labels,
+        index.codebook_kind == CODEBOOK_PER_CLUSTER,
+    )
+    absmax = jnp.max(jnp.abs(y)) if codes.shape[0] else jnp.float32(0.0)
     if index.list_data.dtype == jnp.int8:
         y_int = jnp.clip(
             jnp.round(y / index.scan_scale), -127, 127
         ).astype(jnp.int8)
         y_f32 = y_int.astype(jnp.float32) * index.scan_scale
-        return y_int, jnp.sum(y_f32 * y_f32, axis=-1)
+        return y_int, jnp.sum(y_f32 * y_f32, axis=-1), absmax
     y_stored = y.astype(index.list_data.dtype)
     y_f32 = y_stored.astype(jnp.float32)
-    return y_stored, jnp.sum(y_f32 * y_f32, axis=-1)
+    return y_stored, jnp.sum(y_f32 * y_f32, axis=-1), absmax
 
 
 def _extend_fast(index: Index, codes_np, labels_np, new_ids):
@@ -582,10 +756,15 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
     Split shards of a skewed list share one centroid; rows whose predicted
     shard is full overflow into a sibling shard with space (they score
     identically at probe selection, see _common.split_oversized_lists).
-    Returns None when a centroid group is out of capacity altogether
-    (caller falls back to the full repack+re-split path)."""
+    Returns None when a centroid group is out of capacity altogether, or
+    when an int8 scan cache would clip the new rows at the frozen
+    build-time scan_scale (caller falls back to the repack path, which
+    recomputes the scale — keeps fast- and slow-path recall identical)."""
+    if index._group_inverse is None:
+        index._group_inverse = centroid_group_inverse(index.centers)
     alloc = allocate_append_slots(
-        index.centers, index.list_sizes, index.list_cap, labels_np
+        index.centers, index.list_sizes, index.list_cap, labels_np,
+        group_inverse=index._group_inverse,
     )
     if alloc is None:
         return None
@@ -595,14 +774,19 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
     sj = jnp.asarray(slots)
     ids_j = jnp.asarray(np.asarray(new_ids, np.int32))
 
-    dec_rows, y2_rows = _decode_rows(index, jnp.asarray(codes_np), lj)
+    dec_rows, y2_rows, absmax = _decode_rows(index, jnp.asarray(codes_np), lj)
+    if index.list_data.dtype == jnp.int8 and float(absmax) > 127.0 * float(
+        index.scan_scale
+    ):
+        return None  # would clip at the frozen scale → repack rescales
 
-    list_codes = np.array(index.list_codes, copy=True)
-    list_codes[slab, slots] = codes_np
-    return Index(
+    # codes stay a device array: the append is an O(appended) .at[] scatter
+    # (uint8, same shape discipline as list_data), not a host copy+reupload
+    # of the whole code tensor.
+    new = Index(
         index.metric, index.codebook_kind, index.pq_bits,
         index.centers, index.centers_rot, index.rotation, index.codebook,
-        list_codes,
+        jnp.asarray(index.list_codes).at[lj, sj].set(jnp.asarray(codes_np)),
         index.list_index.at[lj, sj].set(ids_j),
         index.list_sizes + jnp.asarray(counts_new, jnp.int32),
         index.list_data.at[lj, sj].set(dec_rows),
@@ -610,6 +794,8 @@ def _extend_fast(index: Index, codes_np, labels_np, new_ids):
         index.scan_scale,
         headroom=index.headroom,
     )
+    new._group_inverse = index._group_inverse
+    return new
 
 
 @traced("ivf_pq.extend")
@@ -625,9 +811,12 @@ def extend(
     ``new_vectors`` may be any supported dtype (f32/bf16/int8/uint8 — ref
     ivf_pq_build.cuh:1690 dtype templates); rows are cast to f32 one tile
     at a time inside the predict+encode loop, so no full-precision copy of
-    the input is ever materialized."""
+    the input is ever materialized. A host numpy input (incl. memmap) stays
+    host-resident: each tile is uploaded as it is encoded, and only the
+    compressed stream (codes pq_dim B/row + labels) is retained — bounded
+    host residency for 10⁸-row builds."""
     res = ensure(res)
-    x = jnp.asarray(new_vectors)
+    x = new_vectors if isinstance(new_vectors, np.ndarray) else jnp.asarray(new_vectors)
     canonical = DISTANCE_TYPES[index.metric]
     kb_metric = "inner_product" if canonical == "inner_product" else "sqeuclidean"
     # tile the predict+encode to bound the [tile, rot_dim]+einsum workspace
@@ -635,7 +824,7 @@ def extend(
     tile = max(1, res.workspace_rows(4 * (index.rot_dim * 3 + index.pq_dim * index.pq_n_centers), cap=1 << 18))
     codes_parts, label_parts = [], []
     for s in range(0, n, tile):
-        xt = x[s : s + tile].astype(jnp.float32)
+        xt = jnp.asarray(x[s : s + tile]).astype(jnp.float32)
         lt = kmeans_balanced.predict(index.centers, xt, metric=kb_metric, res=res)
         codes_parts.append(
             np.asarray(
@@ -681,7 +870,7 @@ def extend(
     (
         list_codes, list_index, list_sizes, list_data, list_y2, cmap,
         scan_scale,
-    ) = _pack_code_lists(
+    ) = _assemble_lists(
         all_codes, all_ids, all_labels, len(uniq),
         np.asarray(base_codebook), index.codebook_kind,
         np.asarray(base_centers_rot), index.list_data.dtype,
@@ -892,6 +1081,9 @@ def save(filename: str, index: Index) -> None:
             "decoded_dtype": str(np.dtype(index.list_data.dtype).name)
             if index.list_data.dtype != jnp.bfloat16
             else "bfloat16",
+            # ref serializes conservative_memory_allocation
+            # (ivf_pq_serialize.cuh:64); headroom == not conservative
+            "headroom": int(index.headroom),
         },
         {
             "centers": index.centers,
@@ -935,4 +1127,5 @@ def load(filename: str) -> Index:
         list_data,
         list_y2,
         scan_scale,
+        headroom=bool(scalars.get("headroom", 1)),
     )

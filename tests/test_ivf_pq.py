@@ -10,8 +10,20 @@ import jax.numpy as jnp
 
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import brute_force, ivf_pq, refine
+from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.random import make_blobs
 from raft_tpu.stats import neighborhood_recall
+
+
+def _encode_for_test(index, rows):
+    """(codes_np, labels_np) for rows, via the index's own quantizers."""
+    xt = jnp.asarray(rows, jnp.float32)
+    labels = kmeans_balanced.predict(index.centers, xt, metric="sqeuclidean")
+    codes = ivf_pq._encode(
+        index.rotation, index.centers, index.centers_rot, index.codebook,
+        xt, labels, index.codebook_kind,
+    )
+    return np.asarray(codes), np.asarray(labels)
 
 
 @pytest.fixture(scope="module")
@@ -268,6 +280,30 @@ class TestExtendFastPath:
         np.testing.assert_array_equal(
             np.sort(np.asarray(fi), axis=1), np.sort(np.asarray(si), axis=1)
         )
+
+    def test_int8_clip_falls_back_to_repack(self):
+        """Appending rows whose reconstruction exceeds the frozen int8
+        scan_scale must take the repack path (which recomputes the scale) —
+        the fast path would silently clip stored values and distort y2."""
+        x = self._mk()
+        params = ivf_pq.IndexParams(
+            n_lists=16, pq_dim=16, kmeans_n_iters=5, decoded_dtype="int8"
+        )
+        index = ivf_pq.build(params, x[:3800])
+        # rows far outside the build-time magnitude range: reconstruction
+        # absmax is guaranteed past 127*scan_scale
+        extra = x[3800:3900] * 50.0
+        ids = jnp.arange(3800, 3900, dtype=jnp.int32)
+        fast = ivf_pq._extend_fast(
+            index,
+            # encode through the public path to get codes/labels
+            *_encode_for_test(index, extra),
+            np.asarray(ids),
+        )
+        assert fast is None  # would clip → must decline the fast path
+        ext = ivf_pq.extend(index, extra, ids)  # slow path rescales
+        assert ext.size == 3900
+        assert float(ext.scan_scale) > float(index.scan_scale)
 
     def test_overflow_falls_back(self):
         x = self._mk()
