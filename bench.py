@@ -2,9 +2,10 @@
 """Headline benchmark — prints ONE JSON line to stdout.
 
 Headline (BASELINE config #4, the north star): IVF-PQ search QPS at
-recall>=0.95 on a DEEP-shaped synthetic workload (100k x 96 float32,
-clustered like real embedding data — the reference's make_blobs test
-recipe — 10k queries, k=10).  The operating point is found by sweeping
+recall>=0.95 on a DEEP-shaped synthetic workload (500k x 96 float32 on
+the accelerator — RAFT_TPU_BENCH_N overrides — clustered like real
+embedding data, the reference's make_blobs test recipe; 10k queries,
+k=10).  The operating point is found by sweeping
 n_probes (with exact refinement, fused into the search program) until
 recall >= 0.95 vs exact ground truth, then QPS is measured at that
 point.  ``vs_baseline`` is the speedup over exact tiled brute-force kNN
@@ -139,12 +140,20 @@ def main() -> None:
     from raft_tpu.neighbors.refine import refine as refine_fn
 
     on_accel = platform != "cpu"
-    # Full DEEP-shaped workload on the accelerator; reduced on CPU fallback
-    # so the line is still produced in bounded time.
+    # DEEP-shaped workload on the accelerator — n large enough that the
+    # index's sublinear scan visibly beats exact brute force (VERDICT r2:
+    # "the headline workload must grow until that win is visible"); reduced
+    # on CPU fallback so the line is still produced in bounded time.
     if on_accel:
-        n, d, n_q, k = 100_000, 96, 10_000, 10
+        n = int(os.environ.get("RAFT_TPU_BENCH_N", 500_000))
+        d, n_q, k = 96, 10_000, 10
     else:
         n, d, n_q, k = 12_000, 96, 300, 10
+    # hard wall-clock budget: emit the best-so-far operating point rather
+    # than let a cold-compile sweep run into the driver's time cap
+    deadline = time.monotonic() + float(
+        os.environ.get("RAFT_TPU_BENCH_DEADLINE_S", 1500 if on_accel else 600)
+    )
 
     # Clustered synthetic data (mixture of gaussians): real ANN corpora
     # (DEEP/SIFT embeddings) are clustered, and the reference's tests build
@@ -172,13 +181,15 @@ def main() -> None:
     gt_ids = np.asarray(gt_i)
     t_exact = timeit(exact, queries)
 
-    # --- IVF-PQ build
+    # --- IVF-PQ build (n_lists tracks n so probed rows stay ~constant as
+    # the workload grows — the reference's ~n/250 rule of thumb)
     params = ivf_pq.IndexParams(
-        n_lists=1024 if on_accel else 256,
+        n_lists=max(1024, n // 250) if on_accel else 256,
         metric="sqeuclidean",
         pq_dim=d // 2,
         pq_bits=8,
         kmeans_n_iters=10,
+        kmeans_trainset_fraction=min(0.5, 200_000 / n),
     )
     t0 = time.perf_counter()
     index = ivf_pq.build(params, dataset, res=res)
@@ -212,6 +223,9 @@ def main() -> None:
             chosen = (n_probes, float(hits), fn)
             break
         chosen = (n_probes, float(hits), fn)  # keep best-so-far operating point
+        if time.monotonic() > deadline:
+            print(f"deadline hit at n_probes={n_probes}", file=sys.stderr)
+            break
 
     n_probes, recall, fn = chosen
     t_ours = timeit(fn, queries)
@@ -221,7 +235,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "ivf_pq_qps_deep100k_q1k_k10_recall95",
+                "metric": f"ivf_pq_qps_deep{n // 1000}k_q{n_q // 1000}k_k10_recall95",
                 "value": round(qps, 1),
                 "unit": "queries/s",
                 "vs_baseline": round(qps / exact_qps, 3),

@@ -315,4 +315,196 @@ int rt_pack_list_layout(const int64_t* labels, int64_t n, int64_t n_lists,
   }
 }
 
+// Host pairwise distance matrix (ref: raft_runtime/distance/
+// pairwise_distance.hpp): out[i, j] = dist(x[i], y[j]); threaded over x
+// rows. Covers the metric codes the ctypes layer shares.
+int rt_pairwise_distance_host(const float* x, int64_t m, const float* y,
+                              int64_t n, int64_t d, int metric, float* out,
+                              int n_threads) {
+  try {
+    auto mc = static_cast<metric_code>(metric);
+    if (n_threads <= 0)
+      n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    n_threads = std::max(1, std::min<int>(n_threads, 64));
+    auto worker = [&](std::int64_t b, std::int64_t e) {
+      for (std::int64_t i = b; i < e; ++i) {
+        const float* xv = x + i * d;
+        float x2 = 0.f;
+        for (std::int64_t t = 0; t < d; ++t) x2 += xv[t] * xv[t];
+        const float xnorm = std::max(std::sqrt(x2), 1e-12f);
+        for (std::int64_t j = 0; j < n; ++j) {
+          const float* yv = y + j * d;
+          float ip = 0.f, y2 = 0.f;
+          for (std::int64_t t = 0; t < d; ++t) {
+            ip += xv[t] * yv[t];
+            y2 += yv[t] * yv[t];
+          }
+          float v;
+          switch (mc) {
+            case metric_code::inner_product: v = ip; break;
+            case metric_code::cosine:
+              v = 1.f - ip / (xnorm * std::max(std::sqrt(y2), 1e-12f));
+              break;
+            default:
+              v = std::max(x2 + y2 - 2.f * ip, 0.f);
+              if (mc == metric_code::euclidean) v = std::sqrt(v);
+          }
+          out[i * n + j] = v;
+        }
+      }
+    };
+    if (m < 16 || n_threads == 1) {
+      worker(0, m);
+      return 0;
+    }
+    std::int64_t chunk = (m + n_threads - 1) / n_threads;
+    std::vector<std::thread> ts;
+    for (int t = 0; t < n_threads; ++t) {
+      std::int64_t b = t * chunk, e = std::min<std::int64_t>(m, b + chunk);
+      if (b >= e) break;
+      ts.emplace_back([&, b, e] { worker(b, e); });
+    }
+    for (auto& t : ts) t.join();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
+// Host k-means Lloyd iterations from given init centers (ref:
+// raft_runtime/cluster/kmeans.hpp fit/cluster_cost/compute_new_centroids
+// rolled into one entry): assignment is threaded over rows with
+// per-thread partial sums; centers_inout is updated in place; the final
+// assignment's labels and inertia are written out.
+int rt_kmeans_fit_host(const float* x, int64_t n, int64_t d, int64_t k,
+                       int n_iters, float* centers_inout,
+                       int32_t* labels_out, float* inertia_out,
+                       int n_threads) {
+  try {
+    RAFT_TPU_EXPECTS(k > 0 && n > 0, "empty input");
+    if (n_threads <= 0)
+      n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    n_threads = std::max(1, std::min<int>(n_threads, 64));
+    std::int64_t chunk = (n + n_threads - 1) / n_threads;
+    int used = static_cast<int>(
+        std::min<std::int64_t>(n_threads, (n + chunk - 1) / chunk));
+    std::vector<std::vector<double>> part_sum(used);
+    std::vector<std::vector<std::int64_t>> part_cnt(used);
+    std::vector<double> part_cost(used);
+    for (int t = 0; t < used; ++t) {
+      part_sum[t].assign(static_cast<size_t>(k) * d, 0.0);
+      part_cnt[t].assign(k, 0);
+    }
+    for (int it = 0; it < std::max(1, n_iters); ++it) {
+      const bool last = it == std::max(1, n_iters) - 1;
+      auto assign = [&](int tid, std::int64_t b, std::int64_t e) {
+        auto& sums = part_sum[tid];
+        auto& cnts = part_cnt[tid];
+        std::fill(sums.begin(), sums.end(), 0.0);
+        std::fill(cnts.begin(), cnts.end(), 0);
+        double cost = 0.0;
+        for (std::int64_t i = b; i < e; ++i) {
+          const float* xv = x + i * d;
+          float best = std::numeric_limits<float>::infinity();
+          std::int64_t arg = 0;
+          for (std::int64_t c = 0; c < k; ++c) {
+            const float* cv = centers_inout + c * d;
+            float acc = 0.f;
+            for (std::int64_t t2 = 0; t2 < d; ++t2) {
+              float diff = xv[t2] - cv[t2];
+              acc += diff * diff;
+            }
+            if (acc < best) {
+              best = acc;
+              arg = c;
+            }
+          }
+          cost += best;
+          cnts[arg] += 1;
+          double* s = sums.data() + arg * d;
+          for (std::int64_t t2 = 0; t2 < d; ++t2) s[t2] += xv[t2];
+          if (last && labels_out)
+            labels_out[i] = static_cast<std::int32_t>(arg);
+        }
+        part_cost[tid] = cost;
+      };
+      std::vector<std::thread> ts;
+      for (int t = 0; t < used; ++t) {
+        std::int64_t b = t * chunk, e = std::min<std::int64_t>(n, b + chunk);
+        if (b >= e) break;
+        ts.emplace_back([&, t, b, e] { assign(t, b, e); });
+      }
+      for (auto& t : ts) t.join();
+      double total_cost = 0.0;
+      for (int t = 0; t < used; ++t) total_cost += part_cost[t];
+      if (inertia_out) *inertia_out = static_cast<float>(total_cost);
+      if (last) break;  // keep centers consistent with labels/inertia
+      for (std::int64_t c = 0; c < k; ++c) {
+        std::int64_t cnt = 0;
+        for (int t = 0; t < used; ++t) cnt += part_cnt[t][c];
+        if (cnt == 0) continue;  // empty cluster keeps its center
+        for (std::int64_t t2 = 0; t2 < d; ++t2) {
+          double s = 0.0;
+          for (int t = 0; t < used; ++t) s += part_sum[t][c * d + t2];
+          centers_inout[c * d + t2] = static_cast<float>(s / cnt);
+        }
+      }
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
+// R-MAT rectangular edge generator (ref: raft_runtime/random/
+// rmat_rectangular_generator.hpp; quadrant-descent with (a, b, c) theta,
+// xorshift64* PRNG — distribution-parity, not bitwise parity).
+int rt_rmat_host(int r_scale, int c_scale, int64_t n_edges, float theta_a,
+                 float theta_b, float theta_c, uint64_t seed,
+                 int64_t* rows_out, int64_t* cols_out) {
+  try {
+    RAFT_TPU_EXPECTS(r_scale > 0 && c_scale > 0 && r_scale <= 62 &&
+                         c_scale <= 62,
+                     "scale out of range");
+    RAFT_TPU_EXPECTS(theta_a >= 0 && theta_b >= 0 && theta_c >= 0 &&
+                         theta_a + theta_b + theta_c <= 1.f + 1e-6f,
+                     "theta out of range");
+    uint64_t s = seed ? seed : 0x9e3779b97f4a7c15ull;
+    auto next_uniform = [&s]() {
+      // xorshift64* — cheap, good enough for graph-shape parity
+      s ^= s >> 12;
+      s ^= s << 25;
+      s ^= s >> 27;
+      return static_cast<float>((s * 0x2545f4914f6cdd1dull >> 40) &
+                                 0xffffff) /
+             static_cast<float>(0x1000000);
+    };
+    int depth = std::max(r_scale, c_scale);
+    for (std::int64_t e = 0; e < n_edges; ++e) {
+      std::int64_t r = 0, c = 0;
+      for (int lvl = 0; lvl < depth; ++lvl) {
+        float u = next_uniform();
+        int rbit = 0, cbit = 0;
+        if (u < theta_a) {
+        } else if (u < theta_a + theta_b) {
+          cbit = 1;
+        } else if (u < theta_a + theta_b + theta_c) {
+          rbit = 1;
+        } else {
+          rbit = 1;
+          cbit = 1;
+        }
+        // rectangular: only descend axes that still have levels left
+        if (lvl < r_scale) r = (r << 1) | rbit;
+        if (lvl < c_scale) c = (c << 1) | cbit;
+      }
+      rows_out[e] = r;
+      cols_out[e] = c;
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_alg(e);
+  }
+}
+
 }  // extern "C"

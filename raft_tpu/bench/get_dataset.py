@@ -1,0 +1,97 @@
+"""Dataset fetcher CLI (ref: raft-ann-bench ``get_dataset``,
+python/raft-ann-bench/src/raft_ann_bench/get_dataset/__main__.py):
+download a standard ANN benchmark dataset, convert it to the on-disk
+layout the runner consumes (big-ann ``base.fbin``/``query.fbin``/
+``groundtruth.*`` — see ``datasets.save``/``load``), and generate exact
+groundtruth.
+
+    python -m raft_tpu.bench.get_dataset --dataset sift-128-euclidean \
+        --out-dir data/
+
+Zero-egress environments: pass ``--synthetic`` to generate the
+deterministic synthetic stand-in with the same geometry instead of
+downloading (what the test suite and offline benches use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+#: ann-benchmarks HDF5 mirrors (ref: raft-ann-bench get_dataset URLs)
+_ANN_BENCHMARKS_URL = "https://ann-benchmarks.com/{name}.hdf5"
+#: big-ann-benchmarks binary sources for the large datasets
+_BIGANN_URLS = {
+    "deep-100M": "https://storage.yandexcloud.net/yandex-research/ann-datasets/DEEP/base.1B.fbin",
+    "bigann-100M": "https://dl.fbaipublicfiles.com/billion-scale-ann-benchmarks/bigann/base.1B.u8bin",
+}
+
+
+def fetch(name: str, out_dir: str, *, synthetic: bool = False,
+          scale: float = 1.0, k: int = 100) -> str:
+    """Fetch (or synthesize) ``name`` into ``out_dir``; returns the dataset
+    directory path consumable by ``datasets.load``."""
+    from raft_tpu.bench import datasets
+
+    dest = os.path.join(out_dir, name)
+    if os.path.exists(os.path.join(dest, "base.fbin")):
+        print(f"{dest} already present", file=sys.stderr)
+        return dest
+
+    if synthetic:
+        ds = datasets.synthetic(name, scale=scale)
+        ds = datasets.generate_groundtruth(ds, k=k)
+        datasets.save(ds, dest)
+        return dest
+
+    url = (
+        _BIGANN_URLS[name]
+        if name in _BIGANN_URLS
+        else _ANN_BENCHMARKS_URL.format(name=name)
+    )
+    tmp = os.path.join(out_dir, f"{name}.download")
+    os.makedirs(out_dir, exist_ok=True)
+    import urllib.error
+    import urllib.request
+
+    try:
+        print(f"downloading {url} ...", file=sys.stderr)
+        urllib.request.urlretrieve(url, tmp)  # nosec - benchmark data fetch
+    except (urllib.error.URLError, OSError) as e:
+        raise RuntimeError(
+            f"download failed ({e}); in an offline environment use "
+            "--synthetic for the deterministic stand-in with the same "
+            "geometry"
+        ) from e
+    if url.endswith(".hdf5"):
+        ds = datasets.load_hdf5(tmp, name=name)
+    else:
+        base = datasets.read_bin(tmp)
+        ds = datasets.Dataset(name=name, base=base, queries=base[:10_000],
+                              metric="sqeuclidean")
+    if ds.gt_neighbors is None:
+        ds = datasets.generate_groundtruth(ds, k=k)
+    datasets.save(ds, dest)
+    os.remove(tmp)
+    return dest
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("raft_tpu.bench.get_dataset")
+    ap.add_argument("--dataset", default="sift-128-euclidean")
+    ap.add_argument("--out-dir", default="data")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="generate the synthetic stand-in instead of "
+                    "downloading (zero-egress environments)")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("-k", type=int, default=100)
+    args = ap.parse_args(argv)
+    dest = fetch(args.dataset, args.out_dir, synthetic=args.synthetic,
+                 scale=args.scale, k=args.k)
+    print(dest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,9 +169,107 @@ class BallCoverANN(ANN):
         return self._mod.knn_query(self._index, queries, k, n_probes=self._n_probes)
 
 
+class NumpyExactANN(ANN):
+    """Competitor baseline: pure-numpy exact kNN, no JAX/XLA anywhere
+    (ref: the reference benches its algorithms against external
+    competitors, cpp/bench/ann/src/{faiss,hnswlib,ggnn}/ — this is the
+    honest host-CPU floor every accelerated algorithm must beat)."""
+
+    name = "numpy_exact"
+
+    def build(self, dataset):
+        self._x = np.ascontiguousarray(dataset, np.float32)
+        self._x2 = (self._x.astype(np.float64) ** 2).sum(-1)
+
+    def set_search_param(self, param):
+        self._tile = int(param.get("tile", 2048))
+
+    def search(self, queries, k):
+        q = np.ascontiguousarray(queries, np.float32)
+        vals = np.empty((q.shape[0], k), np.float32)
+        ids = np.empty((q.shape[0], k), np.int32)
+        for s in range(0, q.shape[0], self._tile):
+            qt = q[s : s + self._tile]
+            if self.metric == "inner_product":
+                d = -(qt @ self._x.T)
+            else:
+                d = self._x2[None, :] - 2.0 * (qt @ self._x.T)
+            part = np.argpartition(d, k - 1, axis=1)[:, :k]
+            pv = np.take_along_axis(d, part, axis=1)
+            order = np.argsort(pv, axis=1)
+            ids[s : s + self._tile] = np.take_along_axis(part, order, axis=1)
+            vals[s : s + self._tile] = np.take_along_axis(pv, order, axis=1)
+        return vals, ids
+
+
+class HnswANN(ANN):
+    """hnswlib-format comparator: the graph is built here, exported through
+    the hnswlib binary layout, and searched either by real hnswlib (when
+    installed) or by the in-repo loader+search over the same file
+    (ref: cpp/bench/ann/src/hnswlib/ + neighbors/hnsw.hpp wrapper)."""
+
+    name = "hnswlib_format"
+
+    def build(self, dataset):
+        import tempfile
+
+        from raft_tpu.neighbors import cagra, hnsw
+
+        self._hnsw = hnsw
+        self._dim = dataset.shape[1]
+        params = cagra.IndexParams(metric=self.metric, **self.build_param)
+        built = cagra.build(params, jnp.asarray(dataset))
+        # round-trip through the binary format so the comparator exercises
+        # the interchange layout, not the in-memory index
+        fd, self._path = tempfile.mkstemp(suffix=".hnsw")
+        os.close(fd)
+        hnsw.serialize_to_hnswlib(self._path, built)
+        try:  # real hnswlib when available; its absence is the only silent
+            # fallback — a broken load of a present hnswlib must surface,
+            # not quietly benchmark the wrong engine under this label
+            import hnswlib  # type: ignore
+        except ImportError:
+            hnswlib = None
+        if hnswlib is not None:
+            space = "ip" if self.metric == "inner_product" else "l2"
+            self._lib_index = hnswlib.Index(space=space, dim=self._dim)
+            self._lib_index.load_index(self._path)
+        else:
+            self._lib_index = None
+            self._index = hnsw.load(self._path, self._dim, metric=self.metric)
+        self._ef = 64
+
+    def __del__(self):
+        path = getattr(self, "_path", None)
+        if path and os.path.exists(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def set_search_param(self, param):
+        self._ef = int(param.get("ef", 64))
+        if self._lib_index is not None:
+            self._lib_index.set_ef(self._ef)
+
+    def search(self, queries, k):
+        if self._lib_index is not None:
+            ids, dists = self._lib_index.knn_query(np.asarray(queries), k=k)
+            return dists.astype(np.float32), ids.astype(np.int32)
+        return self._hnsw.search(self._index, queries, k, ef=self._ef)
+
+    def save(self, path):
+        import shutil
+
+        shutil.copy(self._path, path)
+
+
 ALGORITHMS = {
     a.name: a
-    for a in (BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, BallCoverANN)
+    for a in (
+        BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, BallCoverANN,
+        NumpyExactANN, HnswANN,
+    )
 }
 
 

@@ -156,6 +156,21 @@ def fit(
     (Python ref: pylibraft.cluster.kmeans.fit — same return triple).
 
     ``n_init`` restarts keep the best inertia, like the reference.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.cluster import kmeans
+    >>> x = np.concatenate(
+    ...     [np.zeros((50, 2)), np.ones((50, 2))]
+    ... ).astype(np.float32)
+    >>> c, inertia, n_iter = kmeans.fit(
+    ...     kmeans.KMeansParams(n_clusters=2, seed=0), x
+    ... )
+    >>> c.shape
+    (2, 2)
+    >>> bool(inertia < 1e-3)  # two exact point-clusters
+    True
     """
     res = ensure(res)
     if params.metric not in ("sqeuclidean", "euclidean", "l2", "cosine"):

@@ -52,8 +52,18 @@ def initialize(
     ``coordinator_address`` plays the role of the NCCL unique id in the
     reference's bootstrap (ref: raft-dask comms.py:137-150 nccl uid create +
     broadcast): every process that dials the same coordinator becomes a rank.
-    With all arguments None, cluster env vars (SLURM/TPU metadata) are used,
-    matching ``jax.distributed.initialize()``'s auto-detection.
+
+    Two rendezvous transports, mirroring the reference's Dask-vs-MPI pair
+    (ref: comms/std_comms.hpp vs comms/mpi_comms.hpp):
+
+    1. **Explicit coordinator** — pass the three arguments (the Dask-style
+       path where an orchestrator hands out the rendezvous).
+    2. **Launcher-provided env** — all arguments None: first the
+       ``RAFT_TPU_COORDINATOR`` / ``RAFT_TPU_NUM_PROCS`` /
+       ``RAFT_TPU_PROC_ID`` env vars (the mpirun/srun contract — an external
+       launcher exports rank/size/rendezvous, exactly how MPI delivers
+       them), then ``jax.distributed.initialize()``'s own cluster
+       auto-detection (SLURM/OpenMPI/TPU metadata).
     """
     global _initialized
     import jax
@@ -61,6 +71,27 @@ def initialize(
     with _init_lock:
         if _initialized:
             return
+        if (
+            coordinator_address is None
+            and num_processes is None
+            and process_id is None
+            and "RAFT_TPU_COORDINATOR" in os.environ
+        ):
+            missing = [
+                v
+                for v in ("RAFT_TPU_NUM_PROCS", "RAFT_TPU_PROC_ID")
+                if v not in os.environ
+            ]
+            if missing:
+                raise RuntimeError(
+                    "RAFT_TPU_COORDINATOR is set but the launcher contract "
+                    f"is incomplete: missing {missing} (all three of "
+                    "RAFT_TPU_COORDINATOR/NUM_PROCS/PROC_ID must be "
+                    "exported together)"
+                )
+            coordinator_address = os.environ["RAFT_TPU_COORDINATOR"]
+            num_processes = int(os.environ["RAFT_TPU_NUM_PROCS"])
+            process_id = int(os.environ["RAFT_TPU_PROC_ID"])
         # CPU cross-process collectives need an explicit implementation.
         if os.environ.get("JAX_PLATFORMS", "") == "cpu" or (
             jax.config.jax_platforms == "cpu"
@@ -170,6 +201,10 @@ class CommsCluster:
             initialize(
                 self.coordinator_address, self.num_processes, self.process_id
             )
+        elif self.num_processes is None and "RAFT_TPU_COORDINATOR" in os.environ:
+            # launcher-provided rendezvous (the mpirun/srun contract — see
+            # initialize()'s transport #2)
+            initialize()
         self._mesh = global_mesh(self.axis_names, self.mesh_shape)
         self._comms = Comms(self._mesh, self.axis_names[0])
         state = get_raft_comm_state(self.session_id)

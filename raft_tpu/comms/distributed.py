@@ -136,12 +136,17 @@ def sharded_ivf_pq_search(
     k: int,
     *,
     n_probes: int = 20,
+    lut_dtype: str = "float32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed IVF-PQ search: each shard probes ``n_probes`` of its own
     lists and scans them; per-shard top-k results (global dataset ids) are
     all-gathered and re-selected — the knn_merge_parts-equivalent collective
     (ref: the reference's MNMG search = local search + merge; BASELINE
     config #5 distributed IVF-PQ).
+
+    ``lut_dtype`` mirrors the single-device SearchParams knob: "float32"
+    (default) upcasts the stored rows for the scan so sharded distances
+    match the single-device search; "bfloat16" halves the scan stream.
 
     Returns replicated (distances [q, k], ids [q, k]).
     """
@@ -186,13 +191,15 @@ def sharded_ivf_pq_search(
         _, probes = select_k(coarse, p_local, select_min=True)
 
         q_rot = jnp.matmul(q, rot.T, precision=_PREC)
-        # scan in the stored dtype (bf16 by default — same HBM-halving path
-        # as the single-device kernel); f32 accumulation via preferred type
+        # scan compute dtype per lut_dtype (f32 upcast of the stored rows by
+        # default — the single-device kernel's knob); f32 accumulation
+        scan_dtype = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
         dec = data_s[probes]                              # [q, p, cap, rot]
         ids = ids_s[probes]                               # [q, p, cap]
         y2 = y2_s[probes]
         ip = lax.dot_general(
-            q_rot.astype(dec.dtype), dec, (((1,), (3,)), ((0,), (0,))),
+            q_rot.astype(scan_dtype), dec.astype(scan_dtype),
+            (((1,), (3,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         if metric == "inner_product":

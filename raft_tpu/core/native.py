@@ -144,6 +144,24 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
         ]
+        lib.rt_pairwise_distance_host.restype = ctypes.c_int
+        lib.rt_pairwise_distance_host.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,                  # x, m
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,  # y, n, d
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int,      # metric, out, threads
+        ]
+        lib.rt_kmeans_fit_host.restype = ctypes.c_int
+        lib.rt_kmeans_fit_host.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        lib.rt_rmat_host.restype = ctypes.c_int
+        lib.rt_rmat_host.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _LIB = lib
         return _LIB
 
@@ -375,6 +393,75 @@ def pack_list_layout(labels: np.ndarray, n_lists: int, max_cap: int):
     if code != 0:
         raise RuntimeError(_lib().rt_alg_last_error().decode())
     return slot, lst, cmap[: n_out.value].copy(), int(cap.value)
+
+
+def pairwise_distance_host(
+    x: np.ndarray, y: np.ndarray, metric: str = "sqeuclidean",
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Native host pairwise distance matrix (ref: raft_runtime/distance/
+    pairwise_distance.hpp role). Returns [m, n] f32."""
+    if metric not in _METRIC_CODES:
+        raise ValueError(f"unsupported native metric {metric!r}")
+    x = np.ascontiguousarray(x, np.float32)
+    y = np.ascontiguousarray(y, np.float32)
+    if x.ndim != 2 or y.ndim != 2 or x.shape[1] != y.shape[1]:
+        raise ValueError("x and y must be 2-D with equal dims")
+    out = np.empty((x.shape[0], y.shape[0]), np.float32)
+    code = _lib().rt_pairwise_distance_host(
+        x.ctypes.data_as(ctypes.c_void_p), x.shape[0],
+        y.ctypes.data_as(ctypes.c_void_p), y.shape[0], x.shape[1],
+        _METRIC_CODES[metric], out.ctypes.data_as(ctypes.c_void_p), n_threads,
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return out
+
+
+def kmeans_fit_host(
+    x: np.ndarray, init_centers: np.ndarray, n_iters: int = 20,
+    n_threads: int = 0,
+):
+    """Native Lloyd iterations from given init centers (ref:
+    raft_runtime/cluster/kmeans.hpp fit/cluster_cost/compute_new_centroids
+    role). Returns (centers [k, d] f32, labels [n] i32, inertia float)."""
+    x = np.ascontiguousarray(x, np.float32)
+    centers = np.array(init_centers, np.float32, copy=True, order="C")
+    if x.ndim != 2 or centers.ndim != 2 or x.shape[1] != centers.shape[1]:
+        raise ValueError("x and init_centers must be 2-D with equal dims")
+    labels = np.empty(x.shape[0], np.int32)
+    inertia = ctypes.c_float()
+    code = _lib().rt_kmeans_fit_host(
+        x.ctypes.data_as(ctypes.c_void_p), x.shape[0], x.shape[1],
+        centers.shape[0], int(n_iters),
+        centers.ctypes.data_as(ctypes.c_void_p),
+        labels.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(inertia), n_threads,
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return centers, labels, float(inertia.value)
+
+
+def rmat_host(
+    r_scale: int, c_scale: int, n_edges: int,
+    theta=(0.57, 0.19, 0.19), seed: int = 0,
+):
+    """Native R-MAT rectangular edge generator (ref: raft_runtime/random/
+    rmat_rectangular_generator.hpp role; distribution parity, not bitwise).
+    Returns (rows [n_edges] i64, cols [n_edges] i64)."""
+    rows = np.empty(n_edges, np.int64)
+    cols = np.empty(n_edges, np.int64)
+    a, b, c = (float(t) for t in theta)
+    code = _lib().rt_rmat_host(
+        int(r_scale), int(c_scale), int(n_edges),
+        a, b, c, int(seed) or 0,
+        rows.ctypes.data_as(ctypes.c_void_p),
+        cols.ctypes.data_as(ctypes.c_void_p),
+    )
+    if code != 0:
+        raise RuntimeError(_lib().rt_alg_last_error().decode())
+    return rows, cols
 
 
 class InterruptibleToken:

@@ -307,6 +307,18 @@ def pairwise_distance(
 
     Row-tiled against the resources' workspace budget so the elementwise
     broadcast never exceeds memory.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.distance import pairwise_distance
+    >>> x = np.zeros((2, 4), np.float32)
+    >>> y = np.ones((3, 4), np.float32)
+    >>> d = pairwise_distance(x, y, metric="euclidean")
+    >>> d.shape
+    (2, 3)
+    >>> bool(np.allclose(np.asarray(d), 2.0))  # ‖0−1‖₂ over 4 dims
+    True
     """
     res = ensure(res)
     x = jnp.asarray(x)

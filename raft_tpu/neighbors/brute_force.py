@@ -105,6 +105,17 @@ def knn(
     (Python ref: pylibraft.neighbors.brute_force.knn — same order of
     returns.) ``inner_product`` selects largest, all distances smallest,
     matching the reference's select-direction logic.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import brute_force
+    >>> x = np.random.default_rng(0).random((1000, 16), dtype=np.float32)
+    >>> dists, ids = brute_force.knn(x, x[:5], 3)
+    >>> ids.shape
+    (5, 3)
+    >>> bool((np.asarray(ids)[:, 0] == np.arange(5)).all())  # self is 1-NN
+    True
     """
     res = ensure(res)
     dataset = jnp.asarray(dataset)

@@ -376,6 +376,11 @@ def _search_jit(
             frontier = ~explored & jnp.isfinite(buf_d)
             return (it < min_iter) | ((it < max_iter) & jnp.any(frontier))
 
+        # strict-upper-triangular mask: earlier[i, j] ⇔ i < j (used to
+        # demote later copies of an id within one candidate batch)
+        c_w = width * deg
+        earlier = jnp.triu(jnp.ones((c_w, c_w), bool), k=1)
+
         def body(state):
             it, buf_i, buf_d, explored, res_i, res_d = state
             # ---- pick search_width best unexplored parents
@@ -394,38 +399,46 @@ def _search_jit(
             vecs = _gather_rows(dataset, cand)                    # [t, w*deg, d]
             cd = _query_distance(qs, vecs, metric)
             cd = jnp.where(cand < 0, jnp.inf, cd)
+            # ---- dedup by broadcast membership instead of sort: the hot
+            # loop's visited-hashmap role (detail/cagra/hashmap.hpp) is two
+            # O(c²)/O(c·itopk) VPU compares — cheap, fused, and free of the
+            # multi-pass bitonic sorts the sorted-id dedup cost per
+            # iteration. A candidate is demoted to inf if (a) an earlier
+            # slot in this batch carries the same id, or (b) the id already
+            # sits in the buffer (whose copy keeps its explored flag).
+            dup_in_batch = jnp.any(
+                (cand[:, :, None] == cand[:, None, :]) & earlier[None], axis=1
+            )                                                     # [t, c]
+            in_buf = jnp.any(cand[:, :, None] == buf_i[:, None, :], axis=2)
+            cd = jnp.where(dup_in_batch | in_buf, jnp.inf, cd)
             # ---- fold filter-passing candidates into the result buffer.
-            # The same node is offered as a candidate by many parents across
-            # iterations, so the merge must dedup by id or the buffer fills
-            # with copies of the single best allowed hit.
+            # Any node already in buf was offered to the result buffer when
+            # first encountered, so the mask above cannot lose hits.
             if filter_words is not None:
+                # res can hold ids long evicted from buf → its own
+                # membership mask keeps the result buffer duplicate-free
+                in_res = jnp.any(
+                    cand[:, :, None] == res_i[:, None, :], axis=2
+                )
                 m_i = jnp.concatenate([res_i, cand], axis=1)
-                m_d = jnp.concatenate([res_d, filt_inf(cand, cd)], axis=1)
-                order, dup = sorted_id_dedup(m_i)
-                ms_i = jnp.take_along_axis(m_i, order, axis=1)
-                ms_d = jnp.take_along_axis(m_d, order, axis=1)
-                ms_d = jnp.where(dup | (ms_i < 0), jnp.inf, ms_d)
+                m_d = jnp.concatenate(
+                    [res_d, jnp.where(in_res, jnp.inf, filt_inf(cand, cd))],
+                    axis=1,
+                )
                 res_d, res_i = select_k(
-                    ms_d, k, select_min=True, input_indices=ms_i
+                    m_d, k, select_min=True, input_indices=m_i
                 )
                 res_i = jnp.where(jnp.isfinite(res_d), res_i, -1)
-            # ---- merge + dedup (plays the visited-hashmap role)
+            # ---- merge into the candidate buffer (ids are now unique)
             all_i = jnp.concatenate([buf_i, cand], axis=1)
             all_d = jnp.concatenate([buf_d, cd], axis=1)
             all_e = jnp.concatenate(
                 [explored, jnp.zeros((tile, width * deg), bool)], axis=1
             )
-            order, dup = sorted_id_dedup(all_i)
-            s_i = jnp.take_along_axis(all_i, order, axis=1)
-            s_d = jnp.take_along_axis(all_d, order, axis=1)
-            s_e = jnp.take_along_axis(all_e, order, axis=1)
-            # a dup's first (stable) copy is the old buffer entry → keeps its
-            # explored flag; later copies are demoted
-            s_d = jnp.where(dup | (s_i < 0), jnp.inf, s_d)
-            buf_d, pos = select_k(s_d, itopk, select_min=True)
-            buf_i = jnp.take_along_axis(s_i, pos, axis=1)
+            buf_d, pos = select_k(all_d, itopk, select_min=True)
+            buf_i = jnp.take_along_axis(all_i, pos, axis=1)
             buf_i = jnp.where(jnp.isfinite(buf_d), buf_i, -1)
-            explored = jnp.take_along_axis(s_e, pos, axis=1)
+            explored = jnp.take_along_axis(all_e, pos, axis=1)
             explored = explored | ~jnp.isfinite(buf_d)
             return it + 1, buf_i, buf_d, explored, res_i, res_d
 

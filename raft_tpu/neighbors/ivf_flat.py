@@ -156,7 +156,20 @@ def build(
     res: Optional[Resources] = None,
 ) -> Index:
     """(ref: ivf_flat build pipeline, detail/ivf_flat_build.cuh:344 —
-    subsample trainset → kmeans_balanced::fit → predict → pack lists)"""
+    subsample trainset → kmeans_balanced::fit → predict → pack lists)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import ivf_flat
+    >>> x = np.random.default_rng(0).random((2000, 16), dtype=np.float32)
+    >>> idx = ivf_flat.build(
+    ...     ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), x
+    ... )
+    >>> d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), idx, x[:4], 3)
+    >>> bool((np.asarray(i)[:, 0] == np.arange(4)).all())  # exact: self is 1-NN
+    True
+    """
     res = ensure(res)
     dataset = jnp.asarray(dataset)
     n, d = dataset.shape

@@ -166,7 +166,10 @@ class Index:
       rotation     [rot_dim, dim] f32   — orthonormal rows
       codebook     per_subspace: [pq_dim, 2**pq_bits, pq_len] f32
                    per_cluster:  [L, 2**pq_bits, pq_len] f32
-      list_codes   [L, cap, pq_dim] uint8 (host numpy — not on the scan path)
+      list_codes   [L, cap, pq_dim] uint8 — device-resident (streamed
+                   assemble + O(appended) fast-extend scatters); not on
+                   the scan path but counted in the HBM budget (the
+                   "+ pq_dim" term of the auto-dtype projection)
       list_data    [L, cap, rot_dim] bf16/f32 — decoded reconstructions
                    (center_rot + codebook decode), the search scan target
       list_y2      [L, cap] f32 — ‖reconstruction‖² (from the stored dtype)
@@ -601,6 +604,20 @@ def build(
     res: Optional[Resources] = None,
 ) -> Index:
     """(ref: build pipeline detail/ivf_pq_build.cuh:1681-1836)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import ivf_pq
+    >>> x = np.random.default_rng(0).random((2000, 32), dtype=np.float32)
+    >>> idx = ivf_pq.build(
+    ...     ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=3), x
+    ... )
+    >>> d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx, x[:4], 5)
+    >>> i.shape
+    (4, 5)
+    >>> bool((np.asarray(i) >= 0).all())
+    True
 
     ``dataset`` may be a host numpy array (including a memmap): it is never
     uploaded wholesale — the trainset subsample and the per-tile

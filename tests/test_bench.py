@@ -56,6 +56,31 @@ def test_run_case_metrics(ds):
     assert r4.qps > 0 and r4.latency_ms > 0 and r4.build_time_s > 0
 
 
+def test_comparator_algorithms(ds):
+    """The harness must bench non-raft_tpu comparators side by side
+    (ref: cpp/bench/ann/src/{faiss,hnswlib}/): the numpy exact baseline is
+    recall-1.0 by construction; the hnswlib-format engine round-trips the
+    interchange file and lands a competitive recall."""
+    exact = runner.run_case(
+        ds, "numpy_exact", {}, [{"tile": 512}], k=10, warmup=0, iters=1
+    )[0]
+    assert exact.recall == pytest.approx(1.0)
+    assert exact.qps > 0
+    hnsw = runner.run_case(
+        ds, "hnswlib_format", {"graph_degree": 16},
+        [{"ef": 64}], k=10, warmup=0, iters=1,
+    )[0]
+    assert hnsw.recall >= 0.8
+    # ≥3 algorithms in one frontier comparison
+    both = exact, hnsw
+    results = list(both) + runner.run_case(
+        ds, "raft_tpu_ivf_flat", {"n_lists": 16}, [{"n_probes": 16}],
+        k=10, warmup=0, iters=1,
+    )
+    fronts = plot.group_frontiers(results)
+    assert len(fronts) == 3
+
+
 def test_run_config_and_export(tmp_path, ds):
     config = {
         "algos": [
@@ -141,3 +166,19 @@ class TestDatasetFormats:
             pass
         with pytest.raises(RuntimeError, match="h5py"):
             D.load_hdf5(str(tmp_path / "x.hdf5"))
+
+
+def test_get_dataset_synthetic(tmp_path):
+    """Fetcher CLI (ref: raft-ann-bench get_dataset): offline --synthetic
+    path writes a loadable dataset dir with groundtruth."""
+    from raft_tpu.bench import datasets, get_dataset
+
+    dest = get_dataset.fetch(
+        "sift-128-euclidean", str(tmp_path), synthetic=True,
+        scale=0.002, k=20,
+    )
+    back = datasets.load(dest)
+    assert back.base.shape[1] == 128
+    assert back.gt_neighbors is not None and back.gt_neighbors.shape[1] == 20
+    # idempotent: second call short-circuits on the existing dir
+    assert get_dataset.fetch("sift-128-euclidean", str(tmp_path)) == dest

@@ -69,6 +69,34 @@ print(f"WORKER_OK {proc_id}", flush=True)
 """
 
 
+_ENV_WORKER_SRC = r"""
+# Launcher-provided rendezvous (the MPI-contract alternative transport):
+# rank/size/coordinator arrive ONLY via env vars, like mpirun/srun exports —
+# no explicit arguments anywhere (ref: comms/mpi_comms.hpp's role of
+# bootstrapping from an external launcher's rank/size).
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from raft_tpu import comms as rc
+
+cluster = rc.CommsCluster(axis_names=("data",))
+cluster.init()
+
+nprocs = int(os.environ["RAFT_TPU_NUM_PROCS"])
+proc_id = int(os.environ["RAFT_TPU_PROC_ID"])
+assert rc.process_count() == nprocs, rc.process_count()
+assert rc.process_index() == proc_id
+c = cluster.comms
+assert c.get_size() == nprocs * 2  # data axis spans all devices
+assert rc.perform_test_comms_allreduce(c)
+assert rc.perform_test_comms_allgatherv(c)
+cluster.destroy()
+print(f"WORKER_OK {proc_id}", flush=True)
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -104,6 +132,45 @@ def test_multiprocess_collectives(nprocs, tmp_path):
             for q in procs:
                 q.kill()
             pytest.fail("multi-process collective test timed out")
+        outs.append((p.returncode, out))
+    for i, (rc_, out) in enumerate(outs):
+        assert rc_ == 0, f"proc {i} rc={rc_}:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
+
+@pytest.mark.parametrize("nprocs", [2])
+def test_env_launcher_bootstrap(nprocs, tmp_path):
+    """Alternative rendezvous transport: rank/size/coordinator provided
+    solely by launcher env vars (the MPI contract), no explicit args."""
+    port = _free_port()
+    script = tmp_path / "env_worker.py"
+    script.write_text(_ENV_WORKER_SRC)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=_REPO_ROOT,
+            env={
+                **os.environ,
+                "PYTHONPATH": _REPO_ROOT
+                + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+                "RAFT_TPU_COORDINATOR": f"localhost:{port}",
+                "RAFT_TPU_NUM_PROCS": str(nprocs),
+                "RAFT_TPU_PROC_ID": str(i),
+            },
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("env-launcher bootstrap test timed out")
         outs.append((p.returncode, out))
     for i, (rc_, out) in enumerate(outs):
         assert rc_ == 0, f"proc {i} rc={rc_}:\n{out[-3000:]}"

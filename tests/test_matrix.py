@@ -37,6 +37,23 @@ def test_select_k_chunked(rng, select_min, batch, n, k):
     np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6)
 
 
+@pytest.mark.parametrize("algo", ["auto", "chunked"])
+def test_select_k_large_k_long_rows(rng, algo):
+    """Large-k coverage (ref: the radix path serves k≫warpsort capacity,
+    matrix/detail/select_radix.cuh): k=4096 over n=10⁶ must run through the
+    multi-level tournament — several narrow sorts, never one 10⁶-wide
+    sort — and agree with a host sort exactly."""
+    n, k = 1_000_000, 4096
+    x = rng.random((2, n)).astype(np.float32)
+    vals, idx = matrix.select_k(x, k, select_min=True, algo=algo)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    want = np.sort(x, axis=1)[:, :k]
+    np.testing.assert_allclose(vals, want, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.take_along_axis(x, idx, axis=1), vals, rtol=1e-6
+    )
+
+
 def test_select_k_algo_agreement(rng):
     """auto/topk/chunked return identical sets on distinct scores."""
     x = rng.random((4, 12_000)).astype(np.float32)

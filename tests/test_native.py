@@ -203,3 +203,45 @@ def test_native_select_k_host(rng):
     s_nan[:, 0] = np.nan
     v3, i3 = native.select_k_host(s_nan, 7)
     assert not np.isnan(v3).any() and (i3 != 0).all()
+
+
+def test_native_pairwise_distance_host(rng):
+    """(ref: raft_runtime/distance/pairwise_distance.hpp role)"""
+    x = rng.random((60, 12), np.float32)
+    y = rng.random((40, 12), np.float32)
+    d = native.pairwise_distance_host(x, y)
+    want = ((x[:, None] - y[None]) ** 2).sum(-1)
+    np.testing.assert_allclose(d, want, rtol=1e-4, atol=1e-5)
+    dc = native.pairwise_distance_host(x, y, metric="cosine")
+    nx = x / np.linalg.norm(x, axis=1, keepdims=True)
+    ny = y / np.linalg.norm(y, axis=1, keepdims=True)
+    np.testing.assert_allclose(dc, 1.0 - nx @ ny.T, rtol=1e-4, atol=1e-5)
+
+
+def test_native_kmeans_fit_host(rng):
+    """(ref: raft_runtime/cluster/kmeans.hpp fit role) — labels/inertia
+    must be self-consistent with the returned centers."""
+    x = np.concatenate(
+        [rng.normal(c, 0.1, (50, 4)) for c in (0.0, 5.0, 10.0)]
+    ).astype(np.float32)
+    init = x[[0, 50, 100]].copy()
+    c, lab, inertia = native.kmeans_fit_host(x, init, n_iters=10)
+    d = ((x[:, None] - c[None]) ** 2).sum(-1)
+    np.testing.assert_array_equal(lab, d.argmin(1))
+    np.testing.assert_allclose(inertia, d.min(1).sum(), rtol=1e-4)
+    # three tight blobs: near-perfect clustering
+    assert inertia < 50.0
+
+
+def test_native_rmat_host():
+    """(ref: raft_runtime/random/rmat_rectangular_generator.hpp role) —
+    in-range rectangular edges with power-law row skew; deterministic per
+    seed."""
+    r, c = native.rmat_host(8, 6, 4000, seed=7)
+    assert r.min() >= 0 and r.max() < 256
+    assert c.min() >= 0 and c.max() < 64
+    counts = np.bincount(r, minlength=256)
+    assert counts.max() > 4000 / 256 * 3  # heavy head vs uniform
+    r2, c2 = native.rmat_host(8, 6, 4000, seed=7)
+    np.testing.assert_array_equal(r, r2)
+    np.testing.assert_array_equal(c, c2)
