@@ -242,6 +242,93 @@ def centroid_group_inverse(centers) -> np.ndarray:
     return inverse
 
 
+def invert_probes(probes: jax.Array, n_lists: int, bucket: int):
+    """Invert the (query, probe) relation into per-list query buckets — the
+    shared front half of the probe-major scan schedule (SURVEY §7 hard
+    part 2; used by the IVF-PQ and IVF-Flat probe-major kernels).
+
+    Traced helper; ``bucket`` (G) must be static. Returns
+    (bucket_list [B], bucket_query [B, G], bucket_pair [B, G], B) where
+    B = q·p//G + n_lists is the static bucket-count bound, bucket_query
+    rows are -1-padded, and bucket_pair holds each slot's original
+    (query-major) pair index for the scatter-back merge."""
+    q, p = probes.shape
+    G = bucket
+    P = q * p
+    pair_list = probes.reshape(P)
+    pair_query = jnp.repeat(jnp.arange(q, dtype=jnp.int32), p)
+    order = jnp.argsort(pair_list, stable=True)
+    sl = pair_list[order]
+    sq = pair_query[order]
+    first = jnp.searchsorted(sl, sl, side="left")
+    pos = jnp.arange(P) - first                                  # rank in list
+    counts = jax.ops.segment_sum(
+        jnp.ones(P, jnp.int32), sl, num_segments=n_lists
+    )
+    nb = (counts + G - 1) // G                                   # buckets/list
+    bucket_off = jnp.cumsum(nb) - nb                             # [n_lists]
+    pair_bucket = bucket_off[sl] + pos // G                      # [P]
+    slot = pos % G
+    B = P // G + n_lists  # static bound: Σ ceil(c/G) ≤ P/G + #nonzero lists
+    bucket_list = jnp.zeros(B, jnp.int32).at[pair_bucket].set(sl)
+    bucket_query = jnp.full((B, G), -1, jnp.int32).at[pair_bucket, slot].set(sq)
+    bucket_pair = jnp.full((B, G), -1, jnp.int32).at[pair_bucket, slot].set(
+        order.astype(jnp.int32)
+    )
+    return bucket_list, bucket_query, bucket_pair, B
+
+
+def select_scan_strategy(
+    strategy: str,
+    q: int,
+    n_probes: int,
+    n_lists: int,
+    list_cap: int,
+    row_dim: int,
+    workspace_bytes: int,
+):
+    """Resolve the IVF scan schedule + probe-major sizing — ONE copy of the
+    auto rule and the bucket/bb arithmetic for both IVF indexes (tuned from
+    the on-chip ``ivf_scan_ab`` A/B; see SearchParams.strategy).
+
+    Returns (strategy, bucket, bb); bucket/bb are None for query_major.
+    """
+    if strategy == "auto":
+        # probe-major pays off when the batch reuses lists heavily: every
+        # list is then streamed ~once instead of once per probing query
+        strategy = (
+            "probe_major"
+            if q >= 256 and q * n_probes >= 4 * n_lists
+            else "query_major"
+        )
+    if strategy != "probe_major":
+        return strategy, None, None
+    reuse = max(1.0, (q * n_probes) / max(n_lists, 1))
+    bucket = int(np.clip(1 << int(np.ceil(np.log2(reuse))), 16, 512))
+    # per-step workspace: bb × (list rows + [G, cap] scores/ids + queries)
+    per_b = list_cap * (row_dim * 4 + bucket * 8) + bucket * row_dim * 4
+    bb = int(np.clip(workspace_bytes // max(per_b, 1), 1, 64))
+    return strategy, bucket, bb
+
+
+def merge_probe_major_partials(vs, is_, bucket_pair, q, n_probes, kk, k):
+    """Scatter per-(pair) top-kk partials back to (query, probe) order and
+    merge per query — the back half of the probe-major schedule. ``vs``/
+    ``is_`` are [B_pad·G, kk]; padding slots carry bucket_pair −1 and are
+    dropped."""
+    P = q * n_probes
+    flat_pair = bucket_pair.reshape(-1)
+    dest = jnp.where(flat_pair >= 0, flat_pair, P)               # P = drop
+    pair_v = jnp.full((P, kk), jnp.inf, jnp.float32).at[dest].set(
+        vs, mode="drop"
+    )
+    pair_i = jnp.full((P, kk), -1, jnp.int32).at[dest].set(is_, mode="drop")
+    return select_k(
+        pair_v.reshape(q, n_probes * kk), k, select_min=True,
+        input_indices=pair_i.reshape(q, n_probes * kk),
+    )
+
+
 def allocate_append_slots(centers, list_sizes, cap, labels, group_inverse=None):
     """Assign a (list, slot) to each new row for an in-place append, or
     return None when a centroid group is out of spare capacity.

@@ -36,18 +36,22 @@ from jax import lax
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import validation
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
 from raft_tpu.neighbors._common import (
     allocate_append_slots,
     centroid_group_inverse,
+    compute_list_layout,
     subsample_trainset,
     coarse_select,
     invalid_mask,
+    invert_probes,
+    merge_probe_major_partials,
     default_max_cap,
     merge_split_lists,
-    pack_padded_lists,
+    select_scan_strategy,
     unpack_lists,
 )
 from raft_tpu.ops.matrix import select_k
@@ -73,9 +77,12 @@ class IndexParams:
 
 @dataclass
 class SearchParams:
-    """(ref: ivf_flat_types.hpp search_params — n_probes)"""
+    """(ref: ivf_flat_types.hpp search_params — n_probes). ``strategy``
+    selects the scan schedule — see ivf_pq.SearchParams.strategy (shared
+    probe-major machinery, _common.invert_probes)."""
 
     n_probes: int = 20
+    strategy: str = "auto"  # auto | query_major | probe_major
 
 
 class Index:
@@ -126,25 +133,62 @@ def _pack_lists(
     dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int,
     metric: str, headroom: bool = True,
 ):
-    """Pack into the padded [n_lists', cap, dim] layout + per-slot norms.
+    """Streamed pack into the padded [n_lists', cap, dim] device layout +
+    per-slot norms: (list, slot) metadata host-side
+    (_common.compute_list_layout, no padded host payload copies), then
+    row chunks scatter into donated device buffers — same 10⁸-row-safe
+    scheme as ivf_pq._assemble_lists (ref: the reference's batched
+    device-side list fill, ivf_flat_build.cuh:163).
 
     Oversized lists are split with duplicated centroids (skew-bounded cap;
     see _common.split_oversized_lists) — returns center_map so the caller
     expands its centroid rows."""
-    list_data, list_index, sizes, center_map = pack_padded_lists(
-        dataset, ids, labels, n_lists,
-        max_cap=default_max_cap(dataset.shape[0], n_lists),
+    n = dataset.shape[0]
+    d = dataset.shape[1]
+    lst, slot, sizes, center_map, cap = compute_list_layout(
+        labels, n_lists,
+        max_cap=default_max_cap(n, n_lists),
         headroom=headroom,
     )
-    norms = np.full(list_index.shape, np.inf, np.float32)
-    valid = list_index >= 0
-    norms[valid] = (list_data.astype(np.float32) ** 2).sum(-1)[valid]
+    L = len(center_map)
+    itemsize = np.dtype(dataset.dtype).itemsize
+    chunk = int(np.clip((256 << 20) // max(d * (itemsize + 8), 1), 8, max(n, 8)))
+
+    l_data = jnp.zeros((L, cap, d), dataset.dtype)
+    l_index = jnp.full((L, cap), -1, jnp.int32)
+    l_norms = jnp.full((L, cap), jnp.inf, jnp.float32)
+    ids = np.asarray(ids, np.int32)
+    lst32 = np.asarray(lst, np.int32)
+    slot32 = np.asarray(slot, np.int32)
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        pad = chunk - (e - s)
+        rows = dataset[s:e]
+        i_c, l_c, s_c = ids[s:e], lst32[s:e], slot32[s:e]
+        if pad:
+            rows = np.concatenate(
+                [np.asarray(rows), np.zeros((pad, d), dataset.dtype)]
+            )
+            i_c = np.concatenate([i_c, np.zeros(pad, np.int32)])
+            l_c = np.concatenate([l_c, np.full(pad, L, np.int32)])  # drop
+            s_c = np.concatenate([s_c, np.zeros(pad, np.int32)])
+        l_data, l_index, l_norms = _scatter_rows_chunk(
+            l_data, l_index, l_norms,
+            jnp.asarray(rows), jnp.asarray(i_c), jnp.asarray(l_c),
+            jnp.asarray(s_c),
+        )
+    return l_data, l_index, jnp.asarray(sizes), l_norms, center_map
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _scatter_rows_chunk(l_data, l_index, l_norms, rows, ids, lst, slot):
+    """Donated chunk scatter for the streamed pack (padding rows carry
+    lst == n_lists → mode="drop")."""
+    rows32 = rows.astype(jnp.float32)
     return (
-        jnp.asarray(list_data),
-        jnp.asarray(list_index),
-        jnp.asarray(sizes),
-        jnp.asarray(norms),
-        center_map,
+        l_data.at[lst, slot].set(rows, mode="drop"),
+        l_index.at[lst, slot].set(ids, mode="drop"),
+        l_norms.at[lst, slot].set(jnp.sum(rows32 * rows32, axis=-1), mode="drop"),
     )
 
 
@@ -171,7 +215,10 @@ def build(
     True
     """
     res = ensure(res)
-    dataset = jnp.asarray(dataset)
+    # host numpy/memmap datasets stay host-resident — the trainset gather
+    # and extend's per-tile stream are the only uploads (see ivf_pq.build)
+    if not isinstance(dataset, np.ndarray):
+        dataset = jnp.asarray(dataset)
     n, d = dataset.shape
     canonical = DISTANCE_TYPES[params.metric]
     if canonical not in ("sqeuclidean", "euclidean", "inner_product", "cosine"):
@@ -188,7 +235,7 @@ def build(
     trainset = (
         subsample_trainset(dataset, n_train, params.seed)
         if n_train < n
-        else dataset
+        else jnp.asarray(dataset)
     )
     centers = kmeans_balanced.fit(kb, trainset.astype(jnp.float32), params.n_lists, res=res)
 
@@ -226,17 +273,41 @@ def extend(
     recompile-tier strategy for XLA static shapes (SURVEY §7 hard part 4).
     """
     res = ensure(res)
-    new_vectors = jnp.asarray(new_vectors, index.list_data.dtype)
-    canonical = DISTANCE_TYPES[index.metric]
-    labels = kmeans_balanced.predict(
-        index.centers,
-        new_vectors.astype(jnp.float32),
-        metric=canonical if canonical in ("cosine", "inner_product") else "sqeuclidean",
-        res=res,
+    x = (
+        new_vectors
+        if isinstance(new_vectors, np.ndarray)
+        else jnp.asarray(new_vectors, index.list_data.dtype)
     )
+    canonical = DISTANCE_TYPES[index.metric]
+    kb_metric = (
+        canonical if canonical in ("cosine", "inner_product") else "sqeuclidean"
+    )
+    n_new = x.shape[0]
+    if isinstance(x, np.ndarray):
+        # tiled predict: a host numpy/memmap input stays host-resident and
+        # only tiles cross to the device (the ivf_pq.extend scheme)
+        tile = max(1, res.workspace_rows(8 * x.shape[1], cap=1 << 18))
+        label_parts = []
+        for s in range(0, n_new, tile):
+            xt = jnp.asarray(x[s : s + tile]).astype(jnp.float32)
+            label_parts.append(
+                np.asarray(kmeans_balanced.predict(index.centers, xt, metric=kb_metric, res=res))
+            )
+        labels = (
+            np.concatenate(label_parts) if label_parts else np.zeros(0, np.int64)
+        )
+    else:
+        # device input: one fused predict, one device→host transfer (no
+        # per-tile round trips through the dispatch tunnel)
+        labels = np.asarray(
+            kmeans_balanced.predict(
+                index.centers, x.astype(jnp.float32), metric=kb_metric, res=res
+            )
+        )
+    new_vectors = x
     old_n = index.size
     if new_indices is None:
-        new_indices = jnp.arange(old_n, old_n + new_vectors.shape[0], dtype=jnp.int32)
+        new_indices = jnp.arange(old_n, old_n + n_new, dtype=jnp.int32)
 
     # fast path: append into spare capacity with device scatters, no repack
     # (the TPU answer to the reference's device-side list growth,
@@ -251,11 +322,12 @@ def extend(
         if alloc is not None:
             slab, slots, counts_new = alloc
             lj, sj = jnp.asarray(slab), jnp.asarray(slots)
-            rows32 = new_vectors.astype(jnp.float32)
+            rows_dev = jnp.asarray(new_vectors, index.list_data.dtype)
+            rows32 = rows_dev.astype(jnp.float32)
             new = Index(
                 index.metric,
                 index.centers,
-                index.list_data.at[lj, sj].set(new_vectors),
+                index.list_data.at[lj, sj].set(rows_dev),
                 index.list_index.at[lj, sj].set(
                     jnp.asarray(new_indices, jnp.int32)
                 ),
@@ -274,9 +346,18 @@ def extend(
     old_rows, old_ids, old_labels = unpack_lists(
         np.asarray(index.list_data), np.asarray(index.list_index)
     )
-    all_rows = np.concatenate([old_rows, np.asarray(new_vectors)])
-    all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
-    all_labels = np.concatenate([old_labels, np.asarray(labels)])
+    if old_rows.shape[0] == 0:
+        # initial fill (build): skip the concatenate so the host never
+        # holds a second copy of a huge dataset
+        all_rows = np.asarray(new_vectors).astype(old_rows.dtype, copy=False)
+        all_ids = np.asarray(new_indices, np.int32)
+        all_labels = np.asarray(labels)
+    else:
+        all_rows = np.concatenate(
+            [old_rows, np.asarray(new_vectors).astype(old_rows.dtype, copy=False)]
+        )
+        all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
+        all_labels = np.concatenate([old_labels, np.asarray(labels)])
     uniq, all_labels = merge_split_lists(np.asarray(index.centers), all_labels)
     base_centers = index.centers[jnp.asarray(uniq)]
     list_data, list_index, list_sizes, list_norms, center_map = _pack_lists(
@@ -354,6 +435,94 @@ def _search_jit(
     )
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "k", "metric", "bucket", "bb")
+)
+def _search_probe_major_jit(
+    queries,      # [q, d] f32
+    centers,      # [L, d] f32
+    list_data,    # [L, cap, d]
+    list_index,   # [L, cap] int32
+    list_norms,   # [L, cap] f32 (inf at padding)
+    filter_words,
+    n_probes: int,
+    k: int,
+    metric: str,
+    bucket: int,
+    bb: int,
+):
+    """Probe-major scan schedule (shared machinery with ivf_pq —
+    _common.invert_probes / merge_probe_major_partials): each list's rows
+    stream from HBM once per bucket instead of once per probing query
+    (the TPU answer to the reference's per-list interleaved_scan
+    scheduling, ivf_flat_interleaved_scan-inl.cuh)."""
+    q, d = queries.shape
+    L, cap, _ = list_data.shape
+    G = bucket
+    kk = min(k, cap)
+
+    probes = coarse_select(queries, centers, metric, n_probes)
+    q2 = jnp.sum(queries * queries, axis=1)
+    qn = jnp.maximum(jnp.sqrt(q2), 1e-12)
+
+    bucket_list, bucket_query, bucket_pair, B = invert_probes(probes, L, G)
+    n_steps = -(-B // bb)
+    B_pad = n_steps * bb
+    bucket_list = jnp.pad(bucket_list, (0, B_pad - B))
+    bucket_query = jnp.pad(
+        bucket_query, ((0, B_pad - B), (0, 0)), constant_values=-1
+    )
+    bucket_pair = jnp.pad(
+        bucket_pair, ((0, B_pad - B), (0, 0)), constant_values=-1
+    )
+
+    def step(start):
+        bl = lax.dynamic_slice_in_dim(bucket_list, start, bb)      # [bb]
+        bq = lax.dynamic_slice_in_dim(bucket_query, start, bb)     # [bb, G]
+        data = list_data[bl].astype(jnp.float32)                   # [bb, cap, d]
+        ids = list_index[bl]
+        norms = list_norms[bl]
+        qq = queries[jnp.clip(bq, 0)]                              # [bb, G, d]
+        # precision must match the query-major einsum (_PREC = HIGHEST):
+        # default precision runs f32 matmuls as bf16 passes on TPU and the
+        # two schedules would disagree on close-neighbor ranks
+        ip = lax.dot_general(
+            qq, data, (((2,), (2,)), ((0,), (0,))),
+            precision=_PREC,
+            preferred_element_type=jnp.float32,
+        )                                                          # [bb, G, cap]
+        if metric == "inner_product":
+            dist = -ip
+        elif metric == "cosine":
+            vn = jnp.sqrt(jnp.maximum(norms, 1e-24))
+            dist = 1.0 - ip / (qn[jnp.clip(bq, 0)][:, :, None] * vn[:, None, :])
+        else:  # (sq)euclidean: ‖y‖² − 2x·y (+‖x‖² later, rank-stable)
+            dist = norms[:, None, :] - 2.0 * ip
+        invalid = invalid_mask(ids, filter_words)                  # [bb, cap]
+        dist = jnp.where(invalid[:, None, :], jnp.inf, dist)
+        dist = jnp.where(bq[:, :, None] < 0, jnp.inf, dist)
+        ids_m = jnp.where(invalid, -1, ids)
+        return select_k(
+            dist.reshape(bb * G, cap), kk, select_min=True,
+            input_indices=jnp.broadcast_to(
+                ids_m[:, None, :], (bb, G, cap)
+            ).reshape(bb * G, cap),
+        )
+
+    vs, is_ = lax.map(step, jnp.arange(n_steps) * bb)
+    v, i = merge_probe_major_partials(
+        vs.reshape(B_pad * G, kk), is_.reshape(B_pad * G, kk),
+        bucket_pair, q, n_probes, kk, k,
+    )
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v + q2[:, None], 0.0))
+    elif metric == "sqeuclidean":
+        v = v + q2[:, None]
+    return v, i
+
+
 @traced("ivf_flat.search")
 def search(
     params: SearchParams,
@@ -377,10 +546,31 @@ def search(
             f"{n_probes}*{index.list_cap}; raise n_probes"
         )
     canonical = DISTANCE_TYPES[index.metric]
+    fw = sample_filter.words if sample_filter is not None else None
+    validation.check_in(
+        params.strategy, ("auto", "query_major", "probe_major"), "strategy"
+    )
+    strategy, bucket, bb = select_scan_strategy(
+        params.strategy, queries.shape[0], n_probes, index.n_lists,
+        index.list_cap, index.dim, res.workspace_limit_bytes,
+    )
+    if strategy == "probe_major":
+        return _search_probe_major_jit(
+            queries,
+            index.centers,
+            index.list_data,
+            index.list_index,
+            index.list_norms,
+            fw,
+            n_probes,
+            int(k),
+            canonical,
+            bucket,
+            bb,
+        )
     # tile queries so the [t, p, cap, d] gather respects the workspace budget
     per_q = 4 * n_probes * index.list_cap * (index.dim + 2)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=256))))
-    fw = sample_filter.words if sample_filter is not None else None
     return _search_jit(
         queries,
         index.centers,

@@ -66,7 +66,10 @@ from raft_tpu.neighbors._common import (
     coarse_select,
     default_max_cap,
     invalid_mask,
+    invert_probes,
+    merge_probe_major_partials,
     merge_split_lists,
+    select_scan_strategy,
     unpack_lists,
 )
 from raft_tpu.ops.matrix import select_k
@@ -143,11 +146,28 @@ class IndexParams:
 
 @dataclass
 class SearchParams:
-    """(ref: ivf_pq_types.hpp:139-172 search_params)"""
+    """(ref: ivf_pq_types.hpp:139-172 search_params)
+
+    ``strategy`` selects the scan schedule (the analog of the reference's
+    compute_similarity kernel-variant choice):
+
+    - ``query_major`` — per query-tile, gather the rows of its probed
+      lists and score them (one batched MXU contraction). HBM reads each
+      list once per *probing query*.
+    - ``probe_major`` — invert the (query, probe) relation: sort pairs by
+      list, bucket each list's probing queries, and scan list-by-list, so
+      each list's rows stream from HBM once per *bucket* (~once per
+      batch) instead of once per query — the SURVEY §7 "probe-major
+      batching" answer to data-dependent gathers. Per-list top-k partials
+      are scattered back and merged per query.
+    - ``auto`` — probe_major when the batch reuses lists heavily
+      (q·n_probes ≫ n_lists and q is large), else query_major.
+    """
 
     n_probes: int = 20
     lut_dtype: str = "float32"                 # float32 | bfloat16 (ref fp8/half analog)
     internal_distance_dtype: str = "float32"   # float32 | bfloat16
+    strategy: str = "auto"                     # auto | query_major | probe_major
 
 
 def _auto_pq_dim(dim: int) -> int:
@@ -870,9 +890,16 @@ def extend(
     old_codes, old_ids, old_labels = unpack_lists(
         np.asarray(index.list_codes), np.asarray(index.list_index)
     )
-    all_codes = np.concatenate([old_codes, codes])
-    all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
-    all_labels = np.concatenate([old_labels, np.asarray(labels)])
+    if old_codes.shape[0] == 0:
+        # initial fill (build): no concatenate — one copy of the code
+        # stream on the host, never two
+        all_codes, all_ids, all_labels = (
+            codes, np.asarray(new_indices, np.int32), np.asarray(labels)
+        )
+    else:
+        all_codes = np.concatenate([old_codes, codes])
+        all_ids = np.concatenate([old_ids, np.asarray(new_indices, np.int32)])
+        all_labels = np.concatenate([old_labels, np.asarray(labels)])
     # merge split shards back to their parent before re-packing (see
     # _common.merge_split_lists — keeps n_lists stable across extends)
     uniq, all_labels = merge_split_lists(np.asarray(index.centers), all_labels)
@@ -1009,6 +1036,111 @@ def _search_jit(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_probes", "k", "metric", "bucket", "bb", "scan_dtype", "acc_dtype",
+    ),
+)
+def _search_probe_major_jit(
+    queries,      # [q, dim] f32
+    centers,      # [L, dim]
+    rotation,     # [rot_dim, dim]
+    list_data,    # [L, cap, rot_dim] bf16/f32/int8
+    list_y2,      # [L, cap] f32
+    list_index,   # [L, cap] int32
+    filter_words,
+    scan_scale,
+    n_probes: int,
+    k: int,
+    metric: str,
+    bucket: int,  # queries per list-bucket (G)
+    bb: int,      # buckets per scan step
+    scan_dtype,
+    acc_dtype,
+):
+    """Probe-major scan schedule: sort the (query, probe) pairs by list,
+    bucket each list's probing queries, and stream list-by-list so every
+    list's rows leave HBM ~once per batch instead of once per probing
+    query (SURVEY §7 hard-part-2 "probe-major batching"; plays the role of
+    the reference's per-list persistent compute_similarity scheduling,
+    ivf_pq_compute_similarity-inl.cuh). Per-(pair) top-k partials are
+    scattered back to (query, probe) order and merged with one select_k.
+    """
+    q, dim = queries.shape
+    L, cap, rot_dim = list_data.shape
+    G = bucket
+    kk = min(k, cap)
+
+    probes = coarse_select(queries, centers, metric, n_probes)  # [q, p]
+    q_rot = jnp.matmul(queries, rotation.T, precision=_PREC)    # [q, rot]
+    q2 = jnp.sum(q_rot * q_rot, axis=1)                         # [q]
+
+    bucket_list, bucket_query, bucket_pair, B = invert_probes(
+        probes, L, G
+    )
+
+    n_steps = -(-B // bb)
+    B_pad = n_steps * bb
+    bucket_list = jnp.pad(bucket_list, (0, B_pad - B))
+    bucket_query = jnp.pad(bucket_query, ((0, B_pad - B), (0, 0)),
+                           constant_values=-1)
+    bucket_pair = jnp.pad(bucket_pair, ((0, B_pad - B), (0, 0)),
+                          constant_values=-1)
+
+    def step(start):
+        bl = lax.dynamic_slice_in_dim(bucket_list, start, bb)      # [bb]
+        bq = lax.dynamic_slice_in_dim(bucket_query, start, bb)     # [bb, G]
+        dec = list_data[bl]                                        # [bb, cap, rot]
+        ids = list_index[bl]                                       # [bb, cap]
+        y2 = list_y2[bl]
+        qr = q_rot[jnp.clip(bq, 0)]                                # [bb, G, rot]
+        if list_data.dtype == jnp.int8:
+            sqs = jnp.max(jnp.abs(qr), axis=2, keepdims=True) / 127.0
+            sqs = jnp.maximum(sqs, 1e-12)
+            q_i8 = jnp.clip(jnp.round(qr / sqs), -127, 127).astype(jnp.int8)
+            ip_i32 = lax.dot_general(
+                q_i8, dec, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32,
+            )                                                      # [bb, G, cap]
+            ip = ip_i32.astype(jnp.float32) * (sqs * scan_scale)
+        else:
+            ip = lax.dot_general(
+                qr.astype(scan_dtype), dec.astype(scan_dtype),
+                (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=acc_dtype,
+            )
+        if metric == "inner_product":
+            scores = (-ip).astype(jnp.float32)
+        else:
+            qq = q2[jnp.clip(bq, 0)].astype(acc_dtype)             # [bb, G]
+            scores = (
+                y2[:, None, :].astype(acc_dtype) - 2.0 * ip + qq[:, :, None]
+            ).astype(jnp.float32)
+        invalid = invalid_mask(ids, filter_words)                  # [bb, cap]
+        scores = jnp.where(invalid[:, None, :], jnp.inf, scores)
+        scores = jnp.where(bq[:, :, None] < 0, jnp.inf, scores)
+        ids_m = jnp.where(invalid, -1, ids)
+        v, i = select_k(
+            scores.reshape(bb * G, cap), kk, select_min=True,
+            input_indices=jnp.broadcast_to(
+                ids_m[:, None, :], (bb, G, cap)
+            ).reshape(bb * G, cap),
+        )
+        return v, i                                                # [bb*G, kk]
+
+    vs, is_ = lax.map(step, jnp.arange(n_steps) * bb)
+    v, i = merge_probe_major_partials(
+        vs.reshape(B_pad * G, kk), is_.reshape(B_pad * G, kk),
+        bucket_pair, q, n_probes, kk, k,
+    )
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
 @traced("ivf_pq.search")
 def search(
     params: SearchParams,
@@ -1039,6 +1171,32 @@ def search(
     acc_dtype = (
         jnp.bfloat16 if params.internal_distance_dtype == "bfloat16" else jnp.float32
     )
+    fw = sample_filter.words if sample_filter is not None else None
+    validation.check_in(
+        params.strategy, ("auto", "query_major", "probe_major"), "strategy"
+    )
+    strategy, bucket, bb = select_scan_strategy(
+        params.strategy, queries.shape[0], n_probes, index.n_lists,
+        index.list_cap, index.rot_dim, res.workspace_limit_bytes,
+    )
+    if strategy == "probe_major":
+        return _search_probe_major_jit(
+            queries,
+            index.centers,
+            index.rotation,
+            index.list_data,
+            index.list_y2,
+            index.list_index,
+            fw,
+            float(index.scan_scale),
+            n_probes,
+            int(k),
+            canonical,
+            bucket,
+            bb,
+            scan_dtype,
+            acc_dtype,
+        )
     # per-query workspace: probe gather of decoded rows + scores + ids
     if index.list_data.dtype == jnp.int8:
         itemsize = 1
@@ -1046,7 +1204,6 @@ def search(
         itemsize = 2 if scan_dtype == jnp.bfloat16 else 4
     per_q = n_probes * index.list_cap * (index.rot_dim * itemsize + 12)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=1024))))
-    fw = sample_filter.words if sample_filter is not None else None
     return _search_jit(
         queries,
         index.centers,

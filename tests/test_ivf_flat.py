@@ -175,3 +175,23 @@ def test_conservative_memory_allocation_skips_headroom():
     assert tight.list_cap <= roomy.list_cap
     sizes = np.asarray(tight.list_sizes)
     assert tight.list_cap == -(-int(sizes.max()) // 8) * 8
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product", "cosine"])
+def test_probe_major_matches_query_major(data, metric):
+    """Probe-major scan schedule (shared _common.invert_probes machinery)
+    must agree with the query-major schedule on every metric."""
+    x, q = data
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=5, metric=metric), x
+    )
+    v1, i1 = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=8, strategy="query_major"), index, q, 10
+    )
+    v2, i2 = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=8, strategy="probe_major"), index, q, 10
+    )
+    assert (np.asarray(i1) == np.asarray(i2)).mean() >= 0.99
+    np.testing.assert_allclose(
+        np.asarray(v1), np.asarray(v2), rtol=1e-4, atol=1e-4
+    )

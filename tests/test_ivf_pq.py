@@ -244,6 +244,65 @@ class TestInt8ScanCache:
         assert ext.size == x.shape[0] + 100
 
 
+class TestProbeMajorStrategy:
+    """The probe-major scan schedule must return the same neighbors as the
+    query-major schedule — same candidate sets, same scores (SURVEY §7
+    hard part 2: probe-major batching; the scan-schedule analog of the
+    reference's compute_similarity kernel variants)."""
+
+    def _built(self, data, **kw):
+        x, _ = data
+        return ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=50, kmeans_n_iters=5, pq_dim=32, **kw),
+            x,
+        )
+
+    @pytest.mark.parametrize("n_probes", [4, 16, 50])
+    def test_matches_query_major(self, data, n_probes):
+        x, q = data
+        index = self._built(data)
+        v1, i1 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=n_probes, strategy="query_major"),
+            index, q, 10,
+        )
+        v2, i2 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=n_probes, strategy="probe_major"),
+            index, q, 10,
+        )
+        assert (np.asarray(i1) == np.asarray(i2)).mean() >= 0.99  # fp ties
+        np.testing.assert_allclose(
+            np.asarray(v1), np.asarray(v2), rtol=1e-4, atol=1e-4
+        )
+
+    def test_int8_and_filtered(self, data):
+        x, q = data
+        index = self._built(data, decoded_dtype="int8")
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        sp_q = ivf_pq.SearchParams(n_probes=16, strategy="query_major")
+        sp_p = ivf_pq.SearchParams(n_probes=16, strategy="probe_major")
+        _, i1 = ivf_pq.search(sp_q, index, q, 10, sample_filter=bs)
+        _, i2 = ivf_pq.search(sp_p, index, q, 10, sample_filter=bs)
+        assert (np.asarray(i2)[np.asarray(i2) >= 0] % 2 == 0).all()
+        assert (np.asarray(i1) == np.asarray(i2)).mean() >= 0.99
+
+    def test_auto_picks_probe_major_on_heavy_reuse(self, data, monkeypatch):
+        x, q = data
+        index = self._built(data)
+        called = {}
+        real = ivf_pq._search_probe_major_jit
+
+        def spy(*a, **k):
+            called["hit"] = True
+            return real(*a, **k)
+
+        monkeypatch.setattr(ivf_pq, "_search_probe_major_jit", spy)
+        big_q = np.repeat(q, 6, axis=0)  # 600 queries ≥ 256, q·p ≥ 4L
+        ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, big_q, 5)
+        assert called.get("hit")
+
+
 class TestExtendFastPath:
     """Device-side fast append (ref: device-side list growth,
     ivf_pq_build.cuh:1501): when new rows fit existing spare capacity the
