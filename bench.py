@@ -248,7 +248,13 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"ivf_pq_qps_deep{n // 1000}k_q{n_q}_k10_recall95",
+                # keep the r1/r2 metric-name format (q1k etc.) when n_q is
+                # a whole number of thousands so history stays comparable
+                "metric": (
+                    f"ivf_pq_qps_deep{n // 1000}k_q"
+                    + (f"{n_q // 1000}k" if n_q % 1000 == 0 else f"{n_q}")
+                    + "_k10_recall95"
+                ),
                 "value": round(qps, 1),
                 "unit": "queries/s",
                 "vs_baseline": round(qps / exact_qps, 3),

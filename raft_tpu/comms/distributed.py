@@ -137,6 +137,7 @@ def sharded_ivf_pq_search(
     *,
     n_probes: int = 20,
     lut_dtype: str = "float32",
+    strategy: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed IVF-PQ search: each shard probes ``n_probes`` of its own
     lists and scans them; per-shard top-k results (global dataset ids) are
@@ -147,6 +148,9 @@ def sharded_ivf_pq_search(
     ``lut_dtype`` mirrors the single-device SearchParams knob: "float32"
     (default) upcasts the stored rows for the scan so sharded distances
     match the single-device search; "bfloat16" halves the scan stream.
+    ``strategy`` selects each shard's local scan schedule (see
+    ivf_pq.SearchParams.strategy — the probe-major schedule streams each
+    local list from HBM once per bucket).
 
     Returns replicated (distances [q, k], ids [q, k]).
     """
@@ -174,11 +178,23 @@ def sharded_ivf_pq_search(
     # bound the per-shard [tile, p, cap, rot] gather against the workspace
     # (same sizing rule as the single-device _search_jit query tiling)
     from raft_tpu.core.resources import ensure as _ensure
+    from raft_tpu.neighbors._common import (
+        run_probe_major,
+        select_scan_strategy,
+    )
 
     ws = _ensure(None).workspace_limit_bytes
     itemsize = jnp.dtype(sharded["list_data"].dtype).itemsize
     per_q = max(1, p_local * cap * (rot_dim * itemsize + 12))
     query_tile = int(min(queries.shape[0], max(1, ws // per_q)))
+    local_strategy, bucket, bb, q_tile = select_scan_strategy(
+        strategy, queries.shape[0], p_local, L_shard, cap, rot_dim, ws,
+        k=k_local,
+    )
+    if local_strategy == "probe_major":
+        # per-step scan work is bounded via bb; the merge buffers via the
+        # probe-major query tile (host-level batching below)
+        query_tile = q_tile
 
     def local(centers_s, valid_s, data_s, y2_s, ids_s, rot, q):
         # coarse over this shard's lists, empty-padding masked out
@@ -194,24 +210,62 @@ def sharded_ivf_pq_search(
         # scan compute dtype per lut_dtype (f32 upcast of the stored rows by
         # default — the single-device kernel's knob); f32 accumulation
         scan_dtype = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
-        dec = data_s[probes]                              # [q, p, cap, rot]
-        ids = ids_s[probes]                               # [q, p, cap]
-        y2 = y2_s[probes]
-        ip = lax.dot_general(
-            q_rot.astype(scan_dtype), dec.astype(scan_dtype),
-            (((1,), (3,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )
-        if metric == "inner_product":
-            scores = -ip
+        n_q = q.shape[0]
+        if local_strategy == "probe_major":
+            # per-shard probe-major schedule (shared scaffold
+            # _common.run_probe_major): each local list streams once per
+            # bucket, partials merge per query
+            kk = min(k_local, cap)
+            q2 = jnp.sum(q_rot * q_rot, axis=1)           # hoisted [q]
+
+            def score_fn(bl, bq):
+                dec = data_s[bl]                          # [bb, cap, rot]
+                ids_b = ids_s[bl]
+                y2_b = y2_s[bl]
+                qr = q_rot[jnp.clip(bq, 0)]               # [bb, G, rot]
+                ip = lax.dot_general(
+                    qr.astype(scan_dtype), dec.astype(scan_dtype),
+                    (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                if metric == "inner_product":
+                    sc = -ip
+                else:
+                    qq2 = q2[jnp.clip(bq, 0)]
+                    sc = y2_b[:, None, :] - 2.0 * ip + qq2[:, :, None]
+                sc = jnp.where(ids_b[:, None, :] < 0, jnp.inf, sc)
+                sc = jnp.where(bq[:, :, None] < 0, jnp.inf, sc)
+                return select_k(
+                    sc.reshape(bb * bucket, cap), kk, select_min=True,
+                    input_indices=jnp.broadcast_to(
+                        ids_b[:, None, :], (bb, bucket, cap)
+                    ).reshape(bb * bucket, cap),
+                )
+
+            v, i = run_probe_major(
+                probes, L_shard, bucket, bb, kk, k_local, score_fn
+            )
         else:
-            qq = jnp.sum(q_rot * q_rot, axis=1)
-            scores = y2 - 2.0 * ip + qq[:, None, None]
-        # padding slots already carry id −1; +inf scores keep them losing
-        scores = jnp.where(ids < 0, jnp.inf, scores)
-        flat_s = scores.reshape(q.shape[0], p_local * cap)
-        flat_i = ids.reshape(q.shape[0], p_local * cap)
-        v, i = select_k(flat_s, k_local, select_min=True, input_indices=flat_i)
+            dec = data_s[probes]                          # [q, p, cap, rot]
+            ids = ids_s[probes]                           # [q, p, cap]
+            y2 = y2_s[probes]
+            ip = lax.dot_general(
+                q_rot.astype(scan_dtype), dec.astype(scan_dtype),
+                (((1,), (3,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            if metric == "inner_product":
+                scores = -ip
+            else:
+                qq = jnp.sum(q_rot * q_rot, axis=1)
+                scores = y2 - 2.0 * ip + qq[:, None, None]
+            # padding slots carry id −1; +inf scores keep them losing
+            scores = jnp.where(ids < 0, jnp.inf, scores)
+            flat_s = scores.reshape(n_q, p_local * cap)
+            flat_i = ids.reshape(n_q, p_local * cap)
+            v, i = select_k(
+                flat_s, k_local, select_min=True, input_indices=flat_i
+            )
         if k_local < k:
             v = jnp.pad(v, ((0, 0), (0, k - k_local)), constant_values=jnp.inf)
             i = jnp.pad(i, ((0, 0), (0, k - k_local)), constant_values=-1)

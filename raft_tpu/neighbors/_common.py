@@ -286,12 +286,17 @@ def select_scan_strategy(
     list_cap: int,
     row_dim: int,
     workspace_bytes: int,
+    k: int = 10,
 ):
     """Resolve the IVF scan schedule + probe-major sizing — ONE copy of the
-    auto rule and the bucket/bb arithmetic for both IVF indexes (tuned from
-    the on-chip ``ivf_scan_ab`` A/B; see SearchParams.strategy).
+    auto rule and the bucket/bb arithmetic for both IVF indexes and the
+    sharded scan (tuned from the on-chip ``ivf_scan_ab`` A/B; see
+    SearchParams.strategy).
 
-    Returns (strategy, bucket, bb); bucket/bb are None for query_major.
+    Returns (strategy, bucket, bb, q_tile); bucket/bb are None for
+    query_major. ``q_tile`` bounds the probe-major merge buffers
+    (pair partials are O(q·n_probes·k)) — callers batch queries host-side
+    at this tile like the query-major path does for its gathers.
     """
     if strategy == "auto":
         # probe-major pays off when the batch reuses lists heavily: every
@@ -302,13 +307,17 @@ def select_scan_strategy(
             else "query_major"
         )
     if strategy != "probe_major":
-        return strategy, None, None
+        return strategy, None, None, None
     reuse = max(1.0, (q * n_probes) / max(n_lists, 1))
     bucket = int(np.clip(1 << int(np.ceil(np.log2(reuse))), 16, 512))
     # per-step workspace: bb × (list rows + [G, cap] scores/ids + queries)
     per_b = list_cap * (row_dim * 4 + bucket * 8) + bucket * row_dim * 4
     bb = int(np.clip(workspace_bytes // max(per_b, 1), 1, 64))
-    return strategy, bucket, bb
+    # merge-buffer bound: pair partials + bucket metadata ≈ 24 B per
+    # (pair, k-slot); allow 4× the workspace for these transients
+    per_q = max(1, n_probes * max(k, 1) * 24)
+    q_tile = int(np.clip(4 * workspace_bytes // per_q, 4096, max(q, 4096)))
+    return strategy, bucket, bb, q_tile
 
 
 def merge_probe_major_partials(vs, is_, bucket_pair, q, n_probes, kk, k):
@@ -326,6 +335,44 @@ def merge_probe_major_partials(vs, is_, bucket_pair, q, n_probes, kk, k):
     return select_k(
         pair_v.reshape(q, n_probes * kk), k, select_min=True,
         input_indices=pair_i.reshape(q, n_probes * kk),
+    )
+
+
+def run_probe_major(probes, n_lists: int, bucket: int, bb: int, kk: int,
+                    k: int, score_fn):
+    """The full probe-major schedule scaffold shared by the IVF-PQ,
+    IVF-Flat, and sharded scans: invert the (query, probe) relation, pad
+    buckets to whole steps, run one scan over bucket batches, and merge the
+    partials per query.
+
+    ``score_fn(bucket_lists [bb], bucket_queries [bb, G]) →
+    (v [bb·G, kk], i [bb·G, kk])`` supplies the index-specific scoring; it
+    must mask padding slots (bucket_queries < 0) to +inf itself.
+    Traced helper; bucket/bb/kk/k static."""
+    q, p = probes.shape
+    G = bucket
+    bucket_list, bucket_query, bucket_pair, B = invert_probes(
+        probes, n_lists, G
+    )
+    n_steps = -(-B // bb)
+    B_pad = n_steps * bb
+    bucket_list = jnp.pad(bucket_list, (0, B_pad - B))
+    bucket_query = jnp.pad(
+        bucket_query, ((0, B_pad - B), (0, 0)), constant_values=-1
+    )
+    bucket_pair = jnp.pad(
+        bucket_pair, ((0, B_pad - B), (0, 0)), constant_values=-1
+    )
+
+    def step(start):
+        bl = jax.lax.dynamic_slice_in_dim(bucket_list, start, bb)
+        bq = jax.lax.dynamic_slice_in_dim(bucket_query, start, bb)
+        return score_fn(bl, bq)
+
+    vs, is_ = jax.lax.map(step, jnp.arange(n_steps) * bb)
+    return merge_probe_major_partials(
+        vs.reshape(B_pad * G, kk), is_.reshape(B_pad * G, kk),
+        bucket_pair, q, p, kk, k,
     )
 
 

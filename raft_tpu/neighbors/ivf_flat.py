@@ -47,10 +47,9 @@ from raft_tpu.neighbors._common import (
     subsample_trainset,
     coarse_select,
     invalid_mask,
-    invert_probes,
-    merge_probe_major_partials,
     default_max_cap,
     merge_split_lists,
+    run_probe_major,
     select_scan_strategy,
     unpack_lists,
 )
@@ -465,20 +464,7 @@ def _search_probe_major_jit(
     q2 = jnp.sum(queries * queries, axis=1)
     qn = jnp.maximum(jnp.sqrt(q2), 1e-12)
 
-    bucket_list, bucket_query, bucket_pair, B = invert_probes(probes, L, G)
-    n_steps = -(-B // bb)
-    B_pad = n_steps * bb
-    bucket_list = jnp.pad(bucket_list, (0, B_pad - B))
-    bucket_query = jnp.pad(
-        bucket_query, ((0, B_pad - B), (0, 0)), constant_values=-1
-    )
-    bucket_pair = jnp.pad(
-        bucket_pair, ((0, B_pad - B), (0, 0)), constant_values=-1
-    )
-
-    def step(start):
-        bl = lax.dynamic_slice_in_dim(bucket_list, start, bb)      # [bb]
-        bq = lax.dynamic_slice_in_dim(bucket_query, start, bb)     # [bb, G]
+    def score_fn(bl, bq):
         data = list_data[bl].astype(jnp.float32)                   # [bb, cap, d]
         ids = list_index[bl]
         norms = list_norms[bl]
@@ -509,11 +495,7 @@ def _search_probe_major_jit(
             ).reshape(bb * G, cap),
         )
 
-    vs, is_ = lax.map(step, jnp.arange(n_steps) * bb)
-    v, i = merge_probe_major_partials(
-        vs.reshape(B_pad * G, kk), is_.reshape(B_pad * G, kk),
-        bucket_pair, q, n_probes, kk, k,
-    )
+    v, i = run_probe_major(probes, L, G, bb, kk, k, score_fn)
     if metric == "inner_product":
         v = -v
     elif metric == "euclidean":
@@ -550,24 +532,41 @@ def search(
     validation.check_in(
         params.strategy, ("auto", "query_major", "probe_major"), "strategy"
     )
-    strategy, bucket, bb = select_scan_strategy(
+    strategy, bucket, bb, q_tile = select_scan_strategy(
         params.strategy, queries.shape[0], n_probes, index.n_lists,
-        index.list_cap, index.dim, res.workspace_limit_bytes,
+        index.list_cap, index.dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        return _search_probe_major_jit(
-            queries,
-            index.centers,
-            index.list_data,
-            index.list_index,
-            index.list_norms,
-            fw,
-            n_probes,
-            int(k),
-            canonical,
-            bucket,
-            bb,
-        )
+        def run_pm(qt):
+            return _search_probe_major_jit(
+                qt,
+                index.centers,
+                index.list_data,
+                index.list_index,
+                index.list_norms,
+                fw,
+                n_probes,
+                int(k),
+                canonical,
+                bucket,
+                bb,
+            )
+
+        n_q = queries.shape[0]
+        if q_tile >= n_q:
+            return run_pm(queries)
+        # host-level query batching bounds the merge buffers (see
+        # select_scan_strategy); pad the tail to one compiled shape
+        vs, is_ = [], []
+        for s in range(0, n_q, q_tile):
+            qt = queries[s : s + q_tile]
+            pad = q_tile - qt.shape[0]
+            if pad:
+                qt = jnp.pad(qt, ((0, pad), (0, 0)))
+            v, i = run_pm(qt)
+            vs.append(v[: v.shape[0] - pad] if pad else v)
+            is_.append(i[: i.shape[0] - pad] if pad else i)
+        return jnp.concatenate(vs), jnp.concatenate(is_)
     # tile queries so the [t, p, cap, d] gather respects the workspace budget
     per_q = 4 * n_probes * index.list_cap * (index.dim + 2)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=256))))

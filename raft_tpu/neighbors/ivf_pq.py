@@ -66,9 +66,8 @@ from raft_tpu.neighbors._common import (
     coarse_select,
     default_max_cap,
     invalid_mask,
-    invert_probes,
-    merge_probe_major_partials,
     merge_split_lists,
+    run_probe_major,
     select_scan_strategy,
     unpack_lists,
 )
@@ -1076,21 +1075,7 @@ def _search_probe_major_jit(
     q_rot = jnp.matmul(queries, rotation.T, precision=_PREC)    # [q, rot]
     q2 = jnp.sum(q_rot * q_rot, axis=1)                         # [q]
 
-    bucket_list, bucket_query, bucket_pair, B = invert_probes(
-        probes, L, G
-    )
-
-    n_steps = -(-B // bb)
-    B_pad = n_steps * bb
-    bucket_list = jnp.pad(bucket_list, (0, B_pad - B))
-    bucket_query = jnp.pad(bucket_query, ((0, B_pad - B), (0, 0)),
-                           constant_values=-1)
-    bucket_pair = jnp.pad(bucket_pair, ((0, B_pad - B), (0, 0)),
-                          constant_values=-1)
-
-    def step(start):
-        bl = lax.dynamic_slice_in_dim(bucket_list, start, bb)      # [bb]
-        bq = lax.dynamic_slice_in_dim(bucket_query, start, bb)     # [bb, G]
+    def score_fn(bl, bq):
         dec = list_data[bl]                                        # [bb, cap, rot]
         ids = list_index[bl]                                       # [bb, cap]
         y2 = list_y2[bl]
@@ -1129,11 +1114,7 @@ def _search_probe_major_jit(
         )
         return v, i                                                # [bb*G, kk]
 
-    vs, is_ = lax.map(step, jnp.arange(n_steps) * bb)
-    v, i = merge_probe_major_partials(
-        vs.reshape(B_pad * G, kk), is_.reshape(B_pad * G, kk),
-        bucket_pair, q, n_probes, kk, k,
-    )
+    v, i = run_probe_major(probes, L, G, bb, kk, k, score_fn)
     if metric == "inner_product":
         v = -v
     elif metric == "euclidean":
@@ -1175,28 +1156,45 @@ def search(
     validation.check_in(
         params.strategy, ("auto", "query_major", "probe_major"), "strategy"
     )
-    strategy, bucket, bb = select_scan_strategy(
+    strategy, bucket, bb, q_tile = select_scan_strategy(
         params.strategy, queries.shape[0], n_probes, index.n_lists,
-        index.list_cap, index.rot_dim, res.workspace_limit_bytes,
+        index.list_cap, index.rot_dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        return _search_probe_major_jit(
-            queries,
-            index.centers,
-            index.rotation,
-            index.list_data,
-            index.list_y2,
-            index.list_index,
-            fw,
-            float(index.scan_scale),
-            n_probes,
-            int(k),
-            canonical,
-            bucket,
-            bb,
-            scan_dtype,
-            acc_dtype,
-        )
+        def run_pm(qt):
+            return _search_probe_major_jit(
+                qt,
+                index.centers,
+                index.rotation,
+                index.list_data,
+                index.list_y2,
+                index.list_index,
+                fw,
+                float(index.scan_scale),
+                n_probes,
+                int(k),
+                canonical,
+                bucket,
+                bb,
+                scan_dtype,
+                acc_dtype,
+            )
+
+        n_q = queries.shape[0]
+        if q_tile >= n_q:
+            return run_pm(queries)
+        # host-level query batching bounds the merge buffers (pair
+        # partials are O(q·p·k)); pad the tail to one compiled shape
+        vs, is_ = [], []
+        for s in range(0, n_q, q_tile):
+            qt = queries[s : s + q_tile]
+            pad = q_tile - qt.shape[0]
+            if pad:
+                qt = jnp.pad(qt, ((0, pad), (0, 0)))
+            v, i = run_pm(qt)
+            vs.append(v[: v.shape[0] - pad] if pad else v)
+            is_.append(i[: i.shape[0] - pad] if pad else i)
+        return jnp.concatenate(vs), jnp.concatenate(is_)
     # per-query workspace: probe gather of decoded rows + scores + ids
     if index.list_data.dtype == jnp.int8:
         itemsize = 1

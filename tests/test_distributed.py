@@ -62,6 +62,34 @@ def test_sharded_ivf_pq_matches_single_device_probe_all():
     np.testing.assert_allclose(np.sort(d_s, 1), np.sort(d_1, 1), rtol=1e-2, atol=1e-2)
 
 
+def test_sharded_strategies_agree():
+    """Each shard's probe-major local scan must return the same merged
+    results as the query-major local scan (the single-device strategy
+    equivalence, lifted to the sharded path)."""
+    key = jax.random.PRNGKey(13)
+    x, _, _ = make_blobs(key, 4096, 32, n_clusters=32, cluster_std=2.0)
+    x = np.asarray(x)
+    q = x[:300] + 0.001  # q ≥ 256 so auto also lands on probe_major
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=5), x
+    )
+    comms = Comms(make_mesh(8))
+    sharded = shard_ivf_pq_index(comms, index)
+    d_q, i_q = sharded_ivf_pq_search(
+        comms, sharded, q, 10, n_probes=4, strategy="query_major"
+    )
+    d_p, i_p = sharded_ivf_pq_search(
+        comms, sharded, q, 10, n_probes=4, strategy="probe_major"
+    )
+    assert (np.asarray(i_q) == np.asarray(i_p)).mean() >= 0.99
+    # distances agree to f32-reassociation tolerance: the two schedules
+    # group the same contractions differently, and ‖y‖²−2ip+‖q‖²
+    # cancellation amplifies the rounding difference
+    np.testing.assert_allclose(
+        np.asarray(d_q), np.asarray(d_p), rtol=2e-3, atol=1e-3
+    )
+
+
 def test_sharded_ivf_pq_search_recall():
     key = jax.random.PRNGKey(3)
     x, _, centers = make_blobs(key, 8000, 32, n_clusters=64)
