@@ -183,6 +183,10 @@ def sharded_ivf_pq_search(
         select_scan_strategy,
     )
 
+    if strategy not in ("auto", "query_major", "probe_major"):
+        raise ValueError(
+            f"strategy must be auto|query_major|probe_major, got {strategy!r}"
+        )
     ws = _ensure(None).workspace_limit_bytes
     itemsize = jnp.dtype(sharded["list_data"].dtype).itemsize
     per_q = max(1, p_local * cap * (rot_dim * itemsize + 12))
@@ -289,28 +293,15 @@ def sharded_ivf_pq_search(
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
     )
-    n_q = queries.shape[0]
-    if query_tile >= n_q:
+    from raft_tpu.neighbors._common import run_query_tiled
+
+    def run_tile(qq):
         return f(
-            sharded["centers"], sharded["list_valid"], sharded["list_data"],
-            sharded["list_y2"], sharded["list_index"], sharded["rotation"],
-            queries,
-        )
-    # host-level query batching; pad the tail so every call shares one
-    # compiled shape
-    vs, is_ = [], []
-    for s in range(0, n_q, query_tile):
-        qq = queries[s : s + query_tile]
-        pad = query_tile - qq.shape[0]
-        if pad:
-            qq = jnp.pad(qq, ((0, pad), (0, 0)))
-        v, i = f(
             sharded["centers"], sharded["list_valid"], sharded["list_data"],
             sharded["list_y2"], sharded["list_index"], sharded["rotation"], qq,
         )
-        vs.append(v[: v.shape[0] - pad] if pad else v)
-        is_.append(i[: i.shape[0] - pad] if pad else i)
-    return jnp.concatenate(vs), jnp.concatenate(is_)
+
+    return run_query_tiled(run_tile, queries, max(1, query_tile))
 
 
 def kmeans_step(

@@ -1,0 +1,124 @@
+"""Pallas probe-major IVF scan: per-list MXU scoring + VMEM-resident top-k.
+
+The probe-major schedule (neighbors/_common.run_probe_major) streams each
+probed list's rows from HBM once per query bucket.  Its XLA formulation
+still materializes the per-step score tensor ([bb, G, cap]) and runs a
+sort-based select over it in HBM.  This kernel fuses the two: for each
+bucket the list's decoded rows are DMA'd into VMEM via a *dynamic block
+index* (scalar-prefetched ``bucket_list`` drives the BlockSpec index_map —
+the Pallas answer to data-dependent gathers, SURVEY §7 hard part 2), the
+[G, cap] score tile is computed on the MXU, and the per-query top-k is
+extracted in VMEM (toolkit.fold_topk) — scores never reach HBM.
+
+Role parity: the reference's per-list ``compute_similarity`` scan kernel
+(cpp/include/raft/neighbors/detail/ivf_pq_compute_similarity-inl.cuh) with
+its shmem LUT + warp select; here the "LUT" is the decoded scan cache and
+the warp queue is the VMEM fold.
+
+Used by the ivf_pq probe-major path when ``RAFT_TPU_PALLAS=1`` (same gate
+as the fused kNN kernel; L2 metrics, float caches, unfiltered — the XLA
+schedule handles filters/int8/IP, and ivf_flat stays on the XLA schedule
+for now); validated in interpret mode on CPU plus a TPU-gated compile
+test.  Bitset filter words don't fit VMEM at the scales this kernel
+targets, hence the unfiltered restriction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.kernels.toolkit import fold_topk
+
+_WORST = float("inf")
+
+
+def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, qg_ref, q2_ref,
+                 vals_ref, out_ids_ref, *, kk: int):
+    """One bucket: score its list's rows against its G queries, keep the
+    per-query top-kk.  dec/y2/ids blocks were selected by the prefetched
+    bucket_list (dynamic index_map); qg/q2 are the bucket's pre-gathered
+    rotated queries (+inf q2 marks padding slots)."""
+    G = qg_ref.shape[1]
+    cap = dec_ref.shape[1]
+    # MXU: [G, rot] × [cap, rot]ᵀ; the stored rows upcast in VMEM (one
+    # [cap, rot] tile), never as a full-index HBM copy
+    ip = jax.lax.dot_general(
+        qg_ref[0], dec_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                    # [G, cap]
+    q2 = q2_ref[0, :]                                    # [G]
+    scores = y2_ref[0, :][None, :] - 2.0 * ip + q2[:, None]
+    ids_row = ids_ref[0, :]                              # [cap]
+    invalid = (ids_row < 0)[None, :] | jnp.isinf(q2)[:, None]
+    scores = jnp.where(invalid, _WORST, scores)
+    cand_i = jnp.broadcast_to(ids_row[None, :], (G, cap))
+    run_v = jnp.full((G, kk), _WORST, jnp.float32)
+    run_i = jnp.full((G, kk), -1, jnp.int32)
+    v, i = fold_topk(run_v, run_i, scores, cand_i, kk)
+    i = jnp.where(jnp.isfinite(v), i, -1)
+    vals_ref[0] = v
+    out_ids_ref[0] = i
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kk", "interpret")
+)
+def ivf_scan_probe_major(
+    bucket_list: jax.Array,   # [B] int32 — list id per bucket
+    q_gathered: jax.Array,    # [B, G, rot] f32 — bucket queries (rotated)
+    q2_gathered: jax.Array,   # [B, G] f32 — ‖q_rot‖² (+inf at padding)
+    list_data: jax.Array,     # [L, cap, rot] f32/bf16 decoded rows
+    list_y2: jax.Array,       # [L, cap] f32
+    list_index: jax.Array,    # [L, cap] int32
+    kk: int,
+    *,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns per-bucket (vals [B, G, kk], ids [B, G, kk]) L2 partials —
+    feed them to _common.merge_probe_major_partials.  The caller supplies
+    the pre-gathered bucket queries (one [B, G, rot] HBM pass — tiny next
+    to the list stream this schedule saves)."""
+    B, G, rot = q_gathered.shape
+    L, cap, _ = list_data.shape
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(       # dec: the bucket's list rows (dynamic)
+                (1, cap, rot), lambda b, bl: (bl[b], 0, 0)
+            ),
+            pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # y2
+            pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # ids
+            pl.BlockSpec((1, G, rot), lambda b, bl: (b, 0, 0)),  # queries
+            pl.BlockSpec((1, G), lambda b, bl: (b, 0)),          # q2
+        ],
+        out_specs=[
+            pl.BlockSpec((1, G, kk), lambda b, bl: (b, 0, 0)),
+            pl.BlockSpec((1, G, kk), lambda b, bl: (b, 0, 0)),
+        ],
+    )
+    vals, ids = pl.pallas_call(
+        functools.partial(_scan_kernel, kk=kk),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, G, kk), jnp.float32),
+            jax.ShapeDtypeStruct((B, G, kk), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        bucket_list,
+        list_data,
+        list_y2,
+        list_index,
+        q_gathered,
+        q2_gathered,
+    )
+    return vals, ids

@@ -314,9 +314,11 @@ def select_scan_strategy(
     per_b = list_cap * (row_dim * 4 + bucket * 8) + bucket * row_dim * 4
     bb = int(np.clip(workspace_bytes // max(per_b, 1), 1, 64))
     # merge-buffer bound: pair partials + bucket metadata ≈ 24 B per
-    # (pair, k-slot); allow 4× the workspace for these transients
+    # (pair, k-slot); allow 4× the workspace for these transients. The
+    # floor is the probe-major minimum batch (256) — NOT a bound override:
+    # huge n_probes·k on a small workspace must still tile hard.
     per_q = max(1, n_probes * max(k, 1) * 24)
-    q_tile = int(np.clip(4 * workspace_bytes // per_q, 4096, max(q, 4096)))
+    q_tile = int(np.clip(4 * workspace_bytes // per_q, 256, max(q, 256)))
     return strategy, bucket, bb, q_tile
 
 
@@ -336,6 +338,26 @@ def merge_probe_major_partials(vs, is_, bucket_pair, q, n_probes, kk, k):
         pair_v.reshape(q, n_probes * kk), k, select_min=True,
         input_indices=pair_i.reshape(q, n_probes * kk),
     )
+
+
+def run_query_tiled(run_fn, queries, q_tile: int):
+    """Host-level query batching: run ``run_fn(q_tile_block) → (v, i)``
+    over fixed-size query tiles (tail zero-padded so every call shares one
+    compiled shape) and concatenate. The single tiling implementation for
+    every probe-major/sharded search entry."""
+    n_q = queries.shape[0]
+    if q_tile >= n_q:
+        return run_fn(queries)
+    vs, is_ = [], []
+    for s in range(0, n_q, q_tile):
+        qt = queries[s : s + q_tile]
+        pad = q_tile - qt.shape[0]
+        if pad:
+            qt = jnp.pad(qt, ((0, pad), (0, 0)))
+        v, i = run_fn(qt)
+        vs.append(v[: v.shape[0] - pad] if pad else v)
+        is_.append(i[: i.shape[0] - pad] if pad else i)
+    return jnp.concatenate(vs), jnp.concatenate(is_)
 
 
 def run_probe_major(probes, n_lists: int, bucket: int, bb: int, kk: int,

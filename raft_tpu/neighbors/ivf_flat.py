@@ -50,6 +50,7 @@ from raft_tpu.neighbors._common import (
     default_max_cap,
     merge_split_lists,
     run_probe_major,
+    run_query_tiled,
     select_scan_strategy,
     unpack_lists,
 )
@@ -552,21 +553,9 @@ def search(
                 bb,
             )
 
-        n_q = queries.shape[0]
-        if q_tile >= n_q:
-            return run_pm(queries)
         # host-level query batching bounds the merge buffers (see
-        # select_scan_strategy); pad the tail to one compiled shape
-        vs, is_ = [], []
-        for s in range(0, n_q, q_tile):
-            qt = queries[s : s + q_tile]
-            pad = q_tile - qt.shape[0]
-            if pad:
-                qt = jnp.pad(qt, ((0, pad), (0, 0)))
-            v, i = run_pm(qt)
-            vs.append(v[: v.shape[0] - pad] if pad else v)
-            is_.append(i[: i.shape[0] - pad] if pad else i)
-        return jnp.concatenate(vs), jnp.concatenate(is_)
+        # select_scan_strategy)
+        return run_query_tiled(run_pm, queries, q_tile)
     # tile queries so the [t, p, cap, d] gather respects the workspace budget
     per_q = 4 * n_probes * index.list_cap * (index.dim + 2)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=256))))

@@ -68,6 +68,7 @@ from raft_tpu.neighbors._common import (
     invalid_mask,
     merge_split_lists,
     run_probe_major,
+    run_query_tiled,
     select_scan_strategy,
     unpack_lists,
 )
@@ -1122,6 +1123,48 @@ def _search_probe_major_jit(
     return v, i
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "k", "metric", "bucket", "interpret"),
+)
+def _search_probe_major_pallas(
+    queries, centers, rotation, list_data, list_y2, list_index,
+    n_probes: int, k: int, metric: str, bucket: int, interpret: bool,
+):
+    """Probe-major schedule with the fused Pallas scan
+    (kernels/ivf_scan.py): per-bucket list rows DMA into VMEM via the
+    scalar-prefetched bucket table, scores + per-query top-k stay in VMEM —
+    the [B, G, cap] score tensor never reaches HBM (the XLA formulation's
+    remaining traffic). L2 metrics, float caches, unfiltered."""
+    from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major
+    from raft_tpu.neighbors._common import (
+        invert_probes as _invert,
+        merge_probe_major_partials as _merge,
+    )
+
+    q, _ = queries.shape
+    L, cap, rot_dim = list_data.shape
+    G = bucket
+    kk = min(k, cap)
+    probes = coarse_select(queries, centers, metric, n_probes)
+    q_rot = jnp.matmul(queries, rotation.T, precision=_PREC)
+    q2 = jnp.sum(q_rot * q_rot, axis=1)
+    bucket_list, bucket_query, bucket_pair, B = _invert(probes, L, G)
+    qg = q_rot[jnp.clip(bucket_query, 0)]                   # [B, G, rot]
+    q2g = jnp.where(bucket_query >= 0, q2[jnp.clip(bucket_query, 0)], jnp.inf)
+    vals, ids = ivf_scan_probe_major(
+        bucket_list, qg, q2g, list_data, list_y2, list_index, kk,
+        interpret=interpret,
+    )
+    v, i = _merge(
+        vals.reshape(B * G, kk), ids.reshape(B * G, kk),
+        bucket_pair, q, n_probes, kk, k,
+    )
+    if metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
 @traced("ivf_pq.search")
 def search(
     params: SearchParams,
@@ -1161,40 +1204,46 @@ def search(
         index.list_cap, index.rot_dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        def run_pm(qt):
-            return _search_probe_major_jit(
-                qt,
-                index.centers,
-                index.rotation,
-                index.list_data,
-                index.list_y2,
-                index.list_index,
-                fw,
-                float(index.scan_scale),
-                n_probes,
-                int(k),
-                canonical,
-                bucket,
-                bb,
-                scan_dtype,
-                acc_dtype,
-            )
+        import os as _os
 
-        n_q = queries.shape[0]
-        if q_tile >= n_q:
-            return run_pm(queries)
+        use_pallas = (
+            _os.environ.get("RAFT_TPU_PALLAS") == "1"
+            and canonical in ("sqeuclidean", "euclidean")
+            and index.list_data.dtype != jnp.int8
+            and fw is None
+        )
+        if use_pallas:
+            from raft_tpu.kernels import interpret_mode
+
+            def run_pm(qt):
+                return _search_probe_major_pallas(
+                    qt, index.centers, index.rotation, index.list_data,
+                    index.list_y2, index.list_index, n_probes, int(k),
+                    canonical, bucket, interpret_mode(),
+                )
+        else:
+            def run_pm(qt):
+                return _search_probe_major_jit(
+                    qt,
+                    index.centers,
+                    index.rotation,
+                    index.list_data,
+                    index.list_y2,
+                    index.list_index,
+                    fw,
+                    float(index.scan_scale),
+                    n_probes,
+                    int(k),
+                    canonical,
+                    bucket,
+                    bb,
+                    scan_dtype,
+                    acc_dtype,
+                )
+
         # host-level query batching bounds the merge buffers (pair
-        # partials are O(q·p·k)); pad the tail to one compiled shape
-        vs, is_ = [], []
-        for s in range(0, n_q, q_tile):
-            qt = queries[s : s + q_tile]
-            pad = q_tile - qt.shape[0]
-            if pad:
-                qt = jnp.pad(qt, ((0, pad), (0, 0)))
-            v, i = run_pm(qt)
-            vs.append(v[: v.shape[0] - pad] if pad else v)
-            is_.append(i[: i.shape[0] - pad] if pad else i)
-        return jnp.concatenate(vs), jnp.concatenate(is_)
+        # partials are O(q·p·k); see select_scan_strategy)
+        return run_query_tiled(run_pm, queries, q_tile)
     # per-query workspace: probe gather of decoded rows + scores + ids
     if index.list_data.dtype == jnp.int8:
         itemsize = 1

@@ -154,3 +154,85 @@ class TestPallasCompilesOnTpu:
             - 2.0 * jnp.matmul(x, c.T, precision=jax.lax.Precision.HIGHEST)
         )
         np.testing.assert_array_equal(np.asarray(idx), d2.argmin(1))
+
+
+class TestIvfScanKernel:
+    """Fused Pallas probe-major IVF scan (kernels/ivf_scan.py) must agree
+    with the XLA probe-major schedule exactly (interpret mode; the compile
+    leg lives in TestPallasCompilesOnTpu-style gating via RAFT_TPU_PALLAS
+    on chip)."""
+
+    def _index(self, n=8000, d=32):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(0)
+        x, _, _ = make_blobs(key, n, d, n_clusters=32, cluster_std=2.0)
+        x = np.asarray(x)
+        return (
+            ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=4), x
+            ),
+            x,
+        )
+
+    def test_matches_xla_probe_major(self, monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+
+        index, x = self._index()
+        q = jnp.asarray(x[:300] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="probe_major")
+        v_x, i_x = ivf_pq.search(sp, index, q, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, index, q, 10)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+
+    def test_pallas_gate_excludes_filters_and_int8(self, monkeypatch):
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        index, x = self._index(n=4000)
+        q = jnp.asarray(x[:300])
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+
+        # every excluded leg must route to the XLA schedule, never the
+        # kernel — a dropped gate condition would scan int8 codes as
+        # floats or skip the filter entirely
+        def boom(*a, **k):
+            raise AssertionError("Pallas path taken for an excluded case")
+
+        monkeypatch.setattr(ivf_pq, "_search_probe_major_pallas", boom)
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="probe_major")
+
+        # (a) filtered search: XLA path + filter honored
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        _, ids = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        ids = np.asarray(ids)
+        assert (ids[ids >= 0] % 2 == 0).all()
+
+        # (b) int8 scan cache: XLA path
+        idx8 = ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=16, pq_dim=16, kmeans_n_iters=3, decoded_dtype="int8"
+            ),
+            x,
+        )
+        ivf_pq.search(sp, idx8, q, 5)
+
+        # (c) inner-product metric: XLA path
+        key = jax.random.PRNGKey(1)
+        xi, _, _ = make_blobs(key, 4000, 32, n_clusters=16)
+        idx_ip = ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=16, pq_dim=16, kmeans_n_iters=3,
+                metric="inner_product",
+            ),
+            np.asarray(xi),
+        )
+        ivf_pq.search(sp, idx_ip, q, 5)
