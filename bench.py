@@ -161,7 +161,12 @@ def main() -> None:
                     return
             print(f"accel leg rc={out.returncode}, no result line; "
                   "falling back to CPU", file=sys.stderr)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            if e.stderr:
+                err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
+                    "utf-8", "replace"
+                )
+                sys.stderr.write(err[-4000:])
             print("accel leg hung past its watchdog (tunnel died mid-run?); "
                   "falling back to CPU", file=sys.stderr)
     run_leg("cpu")
@@ -280,8 +285,10 @@ def run_leg(leg: str) -> None:
     strategy = "query_major"
     # A/B the probe-major scan schedule at the chosen operating point and
     # keep whichever measures faster (results are id-identical — verified
-    # by TestProbeMajorStrategy — so recall carries over)
-    if time.monotonic() < deadline:
+    # by TestProbeMajorStrategy — so recall carries over). Requires 240 s
+    # of slack: a cold compile here must stay inside the parent watchdog's
+    # +420 s margin, or a finished measurement gets discarded.
+    if time.monotonic() < deadline - 240:
         try:
             t_pm = timeit(make_search(n_probes, "probe_major"), queries)
             if t_pm < t_ours:

@@ -15,12 +15,13 @@ Role parity: the reference's per-list ``compute_similarity`` scan kernel
 its shmem LUT + warp select; here the "LUT" is the decoded scan cache and
 the warp queue is the VMEM fold.
 
-Used by the ivf_pq probe-major path when ``RAFT_TPU_PALLAS=1`` (same gate
-as the fused kNN kernel; L2 metrics, float caches, unfiltered — the XLA
-schedule handles filters/int8/IP, and ivf_flat stays on the XLA schedule
-for now); validated in interpret mode on CPU plus a TPU-gated compile
-test.  Bitset filter words don't fit VMEM at the scales this kernel
-targets, hence the unfiltered restriction.
+Used by the ivf_pq AND ivf_flat probe-major paths when
+``RAFT_TPU_PALLAS=1`` (same gate as the fused kNN kernel; L2 metrics,
+float storage, unfiltered — the XLA schedule handles filters/int8/IP);
+the kernel is payload-agnostic: ivf_pq feeds decoded reconstructions +
+their norms, ivf_flat feeds raw rows + row norms.  Validated in interpret
+mode on CPU plus a TPU-gated compile test.  Bitset filter words don't fit
+VMEM at the scales this kernel targets, hence the unfiltered restriction.
 """
 
 from __future__ import annotations

@@ -340,6 +340,21 @@ def merge_probe_major_partials(vs, is_, bucket_pair, q, n_probes, kk, k):
     )
 
 
+def pallas_scan_enabled(metric: str, storage_dtype, filter_words) -> bool:
+    """ONE copy of the fused-Pallas-scan gate shared by ivf_pq and
+    ivf_flat: opt-in via RAFT_TPU_PALLAS=1, L2 metrics, float/bf16 storage
+    (the kernel upcasts in VMEM; int8/uint8 need the quantized-query
+    path), unfiltered (bitset words don't fit VMEM at target scales)."""
+    import os
+
+    return (
+        os.environ.get("RAFT_TPU_PALLAS") == "1"
+        and metric in ("sqeuclidean", "euclidean")
+        and storage_dtype in (jnp.float32, jnp.bfloat16)
+        and filter_words is None
+    )
+
+
 def run_query_tiled(run_fn, queries, q_tile: int):
     """Host-level query batching: run ``run_fn(q_tile_block) → (v, i)``
     over fixed-size query tiles (tail zero-padded so every call shares one

@@ -49,6 +49,7 @@ from raft_tpu.neighbors._common import (
     invalid_mask,
     default_max_cap,
     merge_split_lists,
+    pallas_scan_enabled,
     run_probe_major,
     run_query_tiled,
     select_scan_strategy,
@@ -506,6 +507,48 @@ def _search_probe_major_jit(
     return v, i
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "k", "metric", "bucket", "interpret"),
+)
+def _search_probe_major_pallas(
+    queries, centers, list_data, list_index, list_norms,
+    n_probes: int, k: int, metric: str, bucket: int, interpret: bool,
+):
+    """Probe-major schedule with the fused Pallas scan (kernels/
+    ivf_scan.py — payload-agnostic for L2: here y² = the stored row norms
+    and queries are unrotated). Scores + per-query top-k stay in VMEM."""
+    from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major
+    from raft_tpu.neighbors._common import (
+        invert_probes as _invert,
+        merge_probe_major_partials as _merge,
+    )
+
+    q, d = queries.shape
+    L, cap, _ = list_data.shape
+    G = bucket
+    kk = min(k, cap)
+    probes = coarse_select(queries, centers, metric, n_probes)
+    q2 = jnp.sum(queries * queries, axis=1)
+    bucket_list, bucket_query, bucket_pair, B = _invert(probes, L, G)
+    qg = queries[jnp.clip(bucket_query, 0)]                  # [B, G, d]
+    q2g = jnp.where(bucket_query >= 0, q2[jnp.clip(bucket_query, 0)], jnp.inf)
+    # padding slots carry inf norms; the kernel masks by ids < 0, so zero
+    # them to keep inf out of the MXU product path
+    norms = jnp.where(list_index >= 0, list_norms, 0.0)
+    vals, ids = ivf_scan_probe_major(
+        bucket_list, qg, q2g, list_data, norms, list_index, kk,
+        interpret=interpret,
+    )
+    v, i = _merge(
+        vals.reshape(B * G, kk), ids.reshape(B * G, kk),
+        bucket_pair, q, n_probes, kk, k,
+    )
+    if metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
 @traced("ivf_flat.search")
 def search(
     params: SearchParams,
@@ -538,20 +581,30 @@ def search(
         index.list_cap, index.dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        def run_pm(qt):
-            return _search_probe_major_jit(
-                qt,
-                index.centers,
-                index.list_data,
-                index.list_index,
-                index.list_norms,
-                fw,
-                n_probes,
-                int(k),
-                canonical,
-                bucket,
-                bb,
-            )
+        if pallas_scan_enabled(canonical, index.list_data.dtype, fw):
+            from raft_tpu.kernels import interpret_mode
+
+            def run_pm(qt):
+                return _search_probe_major_pallas(
+                    qt, index.centers, index.list_data, index.list_index,
+                    index.list_norms, n_probes, int(k), canonical, bucket,
+                    interpret_mode(),
+                )
+        else:
+            def run_pm(qt):
+                return _search_probe_major_jit(
+                    qt,
+                    index.centers,
+                    index.list_data,
+                    index.list_index,
+                    index.list_norms,
+                    fw,
+                    n_probes,
+                    int(k),
+                    canonical,
+                    bucket,
+                    bb,
+                )
 
         # host-level query batching bounds the merge buffers (see
         # select_scan_strategy)

@@ -67,6 +67,7 @@ from raft_tpu.neighbors._common import (
     default_max_cap,
     invalid_mask,
     merge_split_lists,
+    pallas_scan_enabled,
     run_probe_major,
     run_query_tiled,
     select_scan_strategy,
@@ -1204,15 +1205,7 @@ def search(
         index.list_cap, index.rot_dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        import os as _os
-
-        use_pallas = (
-            _os.environ.get("RAFT_TPU_PALLAS") == "1"
-            and canonical in ("sqeuclidean", "euclidean")
-            and index.list_data.dtype != jnp.int8
-            and fw is None
-        )
-        if use_pallas:
+        if pallas_scan_enabled(canonical, index.list_data.dtype, fw):
             from raft_tpu.kernels import interpret_mode
 
             def run_pm(qt):

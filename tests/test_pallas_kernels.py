@@ -236,3 +236,54 @@ class TestIvfScanKernel:
             np.asarray(xi),
         )
         ivf_pq.search(sp, idx_ip, q, 5)
+
+    def test_ivf_flat_pallas_matches_xla(self, monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(2)
+        x, _, _ = make_blobs(key, 6000, 32, n_clusters=24, cluster_std=2.0)
+        x = np.asarray(x)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=24, kmeans_n_iters=4), x
+        )
+        q = jnp.asarray(x[:300] + 0.01)
+        sp = ivf_flat.SearchParams(n_probes=6, strategy="probe_major")
+        v_x, i_x = ivf_flat.search(sp, index, q, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_flat.search(sp, index, q, 10)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+
+    def test_ivf_flat_gate_excludes_filters(self, monkeypatch):
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(3)
+        x, _, _ = make_blobs(key, 4000, 16, n_clusters=16, cluster_std=2.0)
+        x = np.asarray(x)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3), x
+        )
+        q = jnp.asarray(x[:300])
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+
+        def boom(*a, **k):
+            raise AssertionError("Pallas path taken for an excluded case")
+
+        monkeypatch.setattr(ivf_flat, "_search_probe_major_pallas", boom)
+        sp = ivf_flat.SearchParams(n_probes=8, strategy="probe_major")
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        _, ids = ivf_flat.search(sp, index, q, 5, sample_filter=bs)
+        ids = np.asarray(ids)
+        assert (ids[ids >= 0] % 2 == 0).all()
+        # cosine metric routes to the XLA schedule too
+        idx_cos = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3, metric="cosine"), x
+        )
+        ivf_flat.search(sp, idx_cos, q, 5)
