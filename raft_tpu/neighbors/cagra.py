@@ -21,9 +21,15 @@ TPU re-design
   *query-batched* beam search: state is a static [tile, itopk] candidate
   buffer with explored flags; one iteration = select_k unexplored parents
   (search_width), one gather of graph rows, one MXU distance batch, and a
-  sorted-id dedup merge back into the buffer (the dedup plays the role of
-  the reference's visited hashmap, detail/cagra/hashmap.hpp). The whole
-  search is one ``lax.while_loop`` inside jit — SURVEY §7 strategy (a).
+  broadcast-membership dedup merge back into the buffer (plays the role
+  of the reference's visited hashmap, detail/cagra/hashmap.hpp — no sorts
+  in the hot loop). The whole search is one ``lax.while_loop`` inside jit
+  — SURVEY §7 strategy (a).
+* **Low-precision datasets** halve the search's HBM gather traffic (its
+  dominant cost): pass ``dataset.astype(jnp.bfloat16)`` to ``build`` —
+  the index keeps the input dtype and ``_gather_rows`` casts only the
+  gathered tile to f32 (the reference's half/int8 dataset templates,
+  cagra_types.hpp:142) — or ``compress()`` to VPQ for 8–16×.
 """
 
 from __future__ import annotations
