@@ -16,12 +16,15 @@ its shmem LUT + warp select; here the "LUT" is the decoded scan cache and
 the warp queue is the VMEM fold.
 
 Used by the ivf_pq AND ivf_flat probe-major paths when
-``RAFT_TPU_PALLAS=1`` (same gate as the fused kNN kernel; L2 metrics,
-float storage, unfiltered — the XLA schedule handles filters/int8/IP);
-the kernel is payload-agnostic: ivf_pq feeds decoded reconstructions +
-their norms, ivf_flat feeds raw rows + row norms.  Validated in interpret
-mode on CPU plus a TPU-gated compile test.  Bitset filter words don't fit
-VMEM at the scales this kernel targets, hence the unfiltered restriction.
+``RAFT_TPU_PALLAS=1`` (same gate as the fused kNN kernel).  Storage:
+f32/bf16 rows upcast in VMEM; ivf_pq's **int8 scan cache takes the fused
+quantized-query leg** (per-query symmetric quantization, int8×int8 MXU
+dot, scan_scale rescale — the memory-lean DEEP-100M mode).  Raw
+int8/uint8 ivf_flat datasets, filtered searches, and inner-product stay
+on the XLA schedule (bitset filter words don't fit VMEM at target
+scales).  The kernel is payload-agnostic: ivf_pq feeds decoded
+reconstructions + their norms, ivf_flat feeds raw rows + row norms.
+Validated in interpret mode on CPU plus a TPU-gated compile test.
 """
 
 from __future__ import annotations
@@ -34,26 +37,39 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.kernels.toolkit import fold_topk
+from raft_tpu.kernels.toolkit import fold_topk, quantize_queries_i8
 
 _WORST = float("inf")
 
 
 def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, qg_ref, q2_ref,
-                 vals_ref, out_ids_ref, *, kk: int):
+                 scale_ref, vals_ref, out_ids_ref, *, kk: int):
     """One bucket: score its list's rows against its G queries, keep the
     per-query top-kk.  dec/y2/ids blocks were selected by the prefetched
     bucket_list (dynamic index_map); qg/q2 are the bucket's pre-gathered
-    rotated queries (+inf q2 marks padding slots)."""
+    rotated queries (+inf q2 marks padding slots).  An int8 dec block
+    takes the quantized-query path: per-query symmetric quantization in
+    VMEM, int8×int8 MXU dot with int32 accumulation, rescale by the
+    per-query scale × the cache's frozen scan_scale (scale_ref, SMEM) —
+    the memory-lean DEEP-100M mode's scoring, fused."""
     G = qg_ref.shape[1]
     cap = dec_ref.shape[1]
-    # MXU: [G, rot] × [cap, rot]ᵀ; the stored rows upcast in VMEM (one
-    # [cap, rot] tile), never as a full-index HBM copy
-    ip = jax.lax.dot_general(
-        qg_ref[0], dec_ref[0].astype(jnp.float32),
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                    # [G, cap]
+    if dec_ref.dtype == jnp.int8:
+        q_i8, sq = quantize_queries_i8(qg_ref[0])        # [G, rot], [G, 1]
+        ip_i32 = jax.lax.dot_general(
+            q_i8, dec_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )                                                # [G, cap]
+        ip = ip_i32.astype(jnp.float32) * (sq * scale_ref[0, 0])
+    else:
+        # MXU: [G, rot] × [cap, rot]ᵀ; the stored rows upcast in VMEM (one
+        # [cap, rot] tile), never as a full-index HBM copy
+        ip = jax.lax.dot_general(
+            qg_ref[0], dec_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [G, cap]
     q2 = q2_ref[0, :]                                    # [G]
     scores = y2_ref[0, :][None, :] - 2.0 * ip + q2[:, None]
     ids_row = ids_ref[0, :]                              # [cap]
@@ -75,11 +91,12 @@ def ivf_scan_probe_major(
     bucket_list: jax.Array,   # [B] int32 — list id per bucket
     q_gathered: jax.Array,    # [B, G, rot] f32 — bucket queries (rotated)
     q2_gathered: jax.Array,   # [B, G] f32 — ‖q_rot‖² (+inf at padding)
-    list_data: jax.Array,     # [L, cap, rot] f32/bf16 decoded rows
+    list_data: jax.Array,     # [L, cap, rot] f32/bf16/int8 stored rows
     list_y2: jax.Array,       # [L, cap] f32
     list_index: jax.Array,    # [L, cap] int32
     kk: int,
     *,
+    scan_scale: float = 1.0,  # int8 cache dequant scale (1.0 for floats)
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns per-bucket (vals [B, G, kk], ids [B, G, kk]) L2 partials —
@@ -100,6 +117,7 @@ def ivf_scan_probe_major(
             pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # ids
             pl.BlockSpec((1, G, rot), lambda b, bl: (b, 0, 0)),  # queries
             pl.BlockSpec((1, G), lambda b, bl: (b, 0)),          # q2
+            pl.BlockSpec(memory_space=pltpu.SMEM),               # scan_scale
         ],
         out_specs=[
             pl.BlockSpec((1, G, kk), lambda b, bl: (b, 0, 0)),
@@ -121,5 +139,6 @@ def ivf_scan_probe_major(
         list_index,
         q_gathered,
         q2_gathered,
+        jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),
     )
     return vals, ids

@@ -160,6 +160,19 @@ def fold_topk(
     return out_v, out_i
 
 
+def quantize_queries_i8(q: jax.Array):
+    """Per-row symmetric int8 quantization of query rows [.., rot] →
+    (q_i8 same shape, scale [.., 1] f32 with a 1e-12 floor). THE one copy
+    of the quantized-query recipe — the Pallas int8 scan leg and both XLA
+    int8 score paths must stay numerically identical for the kernel-vs-XLA
+    parity tests to hold (pure jnp, Pallas-safe)."""
+    sq = jnp.maximum(
+        jnp.max(jnp.abs(q), axis=-1, keepdims=True) / 127.0, 1e-12
+    )
+    q_i8 = jnp.clip(jnp.round(q / sq), -127, 127).astype(jnp.int8)
+    return q_i8, sq
+
+
 def col_ids_tile(rows: int, tile_n: int, col_base) -> jax.Array:
     """Global column indices of a [rows, tile_n] tile starting at col_base
     (the vectorized-iota every tiled kernel needs)."""

@@ -73,6 +73,7 @@ from raft_tpu.neighbors._common import (
     select_scan_strategy,
     unpack_lists,
 )
+from raft_tpu.kernels.toolkit import quantize_queries_i8
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
 from raft_tpu.core.logger import logger as _log
@@ -991,9 +992,7 @@ def _search_jit(
             # memory-lean mode: rows are int8 × global scan_scale; quantize
             # the query per-row and ride the MXU's native int8 path, then
             # rescale the int32 accumulator (the fp8-LUT accuracy analog)
-            sq = jnp.max(jnp.abs(qr), axis=1, keepdims=True) / 127.0
-            sq = jnp.maximum(sq, 1e-12)
-            q_i8 = jnp.clip(jnp.round(qr / sq), -127, 127).astype(jnp.int8)
+            q_i8, sq = quantize_queries_i8(qr)
             ip_i32 = lax.dot_general(
                 q_i8,
                 dec,
@@ -1083,9 +1082,7 @@ def _search_probe_major_jit(
         y2 = list_y2[bl]
         qr = q_rot[jnp.clip(bq, 0)]                                # [bb, G, rot]
         if list_data.dtype == jnp.int8:
-            sqs = jnp.max(jnp.abs(qr), axis=2, keepdims=True) / 127.0
-            sqs = jnp.maximum(sqs, 1e-12)
-            q_i8 = jnp.clip(jnp.round(qr / sqs), -127, 127).astype(jnp.int8)
+            q_i8, sqs = quantize_queries_i8(qr)
             ip_i32 = lax.dot_general(
                 q_i8, dec, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.int32,
@@ -1130,13 +1127,15 @@ def _search_probe_major_jit(
 )
 def _search_probe_major_pallas(
     queries, centers, rotation, list_data, list_y2, list_index,
-    n_probes: int, k: int, metric: str, bucket: int, interpret: bool,
+    scan_scale, n_probes: int, k: int, metric: str, bucket: int,
+    interpret: bool,
 ):
     """Probe-major schedule with the fused Pallas scan
     (kernels/ivf_scan.py): per-bucket list rows DMA into VMEM via the
     scalar-prefetched bucket table, scores + per-query top-k stay in VMEM —
     the [B, G, cap] score tensor never reaches HBM (the XLA formulation's
-    remaining traffic). L2 metrics, float caches, unfiltered."""
+    remaining traffic). L2 metrics, float or int8 caches (the kernel's
+    quantized-query leg handles int8 × scan_scale), unfiltered."""
     from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major
     from raft_tpu.neighbors._common import (
         invert_probes as _invert,
@@ -1155,7 +1154,7 @@ def _search_probe_major_pallas(
     q2g = jnp.where(bucket_query >= 0, q2[jnp.clip(bucket_query, 0)], jnp.inf)
     vals, ids = ivf_scan_probe_major(
         bucket_list, qg, q2g, list_data, list_y2, list_index, kk,
-        interpret=interpret,
+        scan_scale=scan_scale, interpret=interpret,
     )
     v, i = _merge(
         vals.reshape(B * G, kk), ids.reshape(B * G, kk),
@@ -1205,13 +1204,16 @@ def search(
         index.list_cap, index.rot_dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        if pallas_scan_enabled(canonical, index.list_data.dtype, fw):
+        if pallas_scan_enabled(
+            canonical, index.list_data.dtype, fw, allow_int8=True
+        ):
             from raft_tpu.kernels import interpret_mode
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
                     qt, index.centers, index.rotation, index.list_data,
-                    index.list_y2, index.list_index, n_probes, int(k),
+                    index.list_y2, index.list_index,
+                    float(index.scan_scale), n_probes, int(k),
                     canonical, bucket, interpret_mode(),
                 )
         else:

@@ -190,7 +190,7 @@ class TestIvfScanKernel:
             np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
         )
 
-    def test_pallas_gate_excludes_filters_and_int8(self, monkeypatch):
+    def test_pallas_gate_exclusions(self, monkeypatch):
         from raft_tpu.core.bitset import Bitset
         from raft_tpu.neighbors import ivf_pq
         from raft_tpu.random import make_blobs
@@ -200,8 +200,10 @@ class TestIvfScanKernel:
         monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
 
         # every excluded leg must route to the XLA schedule, never the
-        # kernel — a dropped gate condition would scan int8 codes as
-        # floats or skip the filter entirely
+        # kernel — a dropped gate condition would skip the filter or score
+        # the wrong similarity (int8 caches are a SUPPORTED leg now — the
+        # kernel dequantizes by scan_scale; covered by
+        # test_int8_cache_matches_xla)
         def boom(*a, **k):
             raise AssertionError("Pallas path taken for an excluded case")
 
@@ -216,16 +218,7 @@ class TestIvfScanKernel:
         ids = np.asarray(ids)
         assert (ids[ids >= 0] % 2 == 0).all()
 
-        # (b) int8 scan cache: XLA path
-        idx8 = ivf_pq.build(
-            ivf_pq.IndexParams(
-                n_lists=16, pq_dim=16, kmeans_n_iters=3, decoded_dtype="int8"
-            ),
-            x,
-        )
-        ivf_pq.search(sp, idx8, q, 5)
-
-        # (c) inner-product metric: XLA path
+        # (b) inner-product metric: XLA path
         key = jax.random.PRNGKey(1)
         xi, _, _ = make_blobs(key, 4000, 32, n_clusters=16)
         idx_ip = ivf_pq.build(
@@ -287,3 +280,29 @@ class TestIvfScanKernel:
             ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3, metric="cosine"), x
         )
         ivf_flat.search(sp, idx_cos, q, 5)
+
+    def test_int8_cache_matches_xla(self, monkeypatch):
+        """The kernel's quantized-query int8 leg (the memory-lean
+        DEEP-100M mode, fused) must agree with the XLA int8 probe-major
+        schedule."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(4)
+        x, _, _ = make_blobs(key, 6000, 32, n_clusters=24, cluster_std=2.0)
+        x = np.asarray(x)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=24, pq_dim=16, kmeans_n_iters=4, decoded_dtype="int8"
+            ),
+            x,
+        )
+        q = jnp.asarray(x[:300] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=6, strategy="probe_major")
+        v_x, i_x = ivf_pq.search(sp, index, q, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, index, q, 10)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
