@@ -155,6 +155,36 @@ class TestPallasCompilesOnTpu:
         )
         np.testing.assert_array_equal(np.asarray(idx), d2.argmin(1))
 
+    @pytest.mark.parametrize("decoded_dtype", ["bfloat16", "int8"])
+    def test_ivf_scan_compiles(self, decoded_dtype):
+        """ivf_scan's dynamic-BlockSpec gather, SMEM scalar, and (int8
+        leg) quantized MXU dot must survive Mosaic compilation — these are
+        exactly the constructs interpret mode cannot vouch for."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(5)
+        x, _, _ = make_blobs(key, 20000, 96, n_clusters=64, cluster_std=2.0)
+        x = np.asarray(x)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=64, pq_dim=48, kmeans_n_iters=4,
+                decoded_dtype=decoded_dtype,
+            ),
+            x,
+        )
+        q = jnp.asarray(x[:512] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=16, strategy="probe_major")
+        v_x, i_x = ivf_pq.search(sp, index, q, 10)
+        import os
+
+        os.environ["RAFT_TPU_PALLAS"] = "1"
+        try:
+            v_p, i_p = ivf_pq.search(sp, index, q, 10)
+        finally:
+            os.environ.pop("RAFT_TPU_PALLAS", None)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+
 
 class TestIvfScanKernel:
     """Fused Pallas probe-major IVF scan (kernels/ivf_scan.py) must agree
