@@ -43,5 +43,12 @@ def interpret_mode() -> bool:
 
 from raft_tpu.kernels.fused_knn import fused_l2_topk  # noqa: E402
 from raft_tpu.kernels.fused_argmin import fused_l2_argmin  # noqa: E402
+from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major  # noqa: E402
 
-__all__ = ["use_pallas", "interpret_mode", "fused_l2_topk", "fused_l2_argmin"]
+__all__ = [
+    "use_pallas",
+    "interpret_mode",
+    "fused_l2_topk",
+    "fused_l2_argmin",
+    "ivf_scan_probe_major",
+]

@@ -137,3 +137,31 @@ def test_kmeans_balanced_hierarchical_empty_meso():
     centers = kmeans_balanced.fit(params, x, 300)
     assert centers.shape == (300, 4)
     assert np.isfinite(np.asarray(centers)).all()
+
+
+def test_headroom_flag_survives_save_load(tmp_path):
+    """conservative_memory_allocation's headroom policy must round-trip
+    serialization (ref: the reference serializes the flag,
+    ivf_pq_serialize.cuh:64 / ivf_flat_serialize.cuh:66 — ADVICE r2)."""
+    import jax
+    import numpy as np
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.random import make_blobs
+
+    key = jax.random.PRNGKey(7)
+    x, _, _ = make_blobs(key, 1500, 16, n_clusters=8)
+    x = np.asarray(x)
+    for mod, params in (
+        (ivf_pq, ivf_pq.IndexParams(
+            n_lists=8, pq_dim=8, kmeans_n_iters=3,
+            conservative_memory_allocation=True)),
+        (ivf_flat, ivf_flat.IndexParams(
+            n_lists=8, kmeans_n_iters=3,
+            conservative_memory_allocation=True)),
+    ):
+        index = mod.build(params, x)
+        assert index.headroom is False
+        path = str(tmp_path / f"{mod.__name__.split('.')[-1]}.idx")
+        mod.save(path, index)
+        loaded = mod.load(path)
+        assert loaded.headroom is False
