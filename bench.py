@@ -197,13 +197,20 @@ def run_leg(leg: str) -> None:
         n = int(os.environ.get("RAFT_TPU_BENCH_N", 500_000))
         d, n_q, k = 96, 10_000, 10
     else:
-        n, d, n_q, k = 12_000, 96, 300, 10
+        # sized so the index visibly beats exact brute force even on the
+        # fallback platform (vs_baseline > 1) while the whole leg stays
+        # inside the driver's patience (~4 min measured end to end)
+        n, d, n_q, k = 24_000, 96, 400, 10
     # hard wall-clock budget: emit the best-so-far operating point rather
     # than let a cold-compile sweep run into the driver's time cap
+    # the CPU leg keeps its own (shorter) budget: main() setdefaults the
+    # accel var for the child, and inheriting 1500 s here would let the
+    # fallback overrun exactly when the accel leg already burned the clock
+    deadline_env = (
+        "RAFT_TPU_BENCH_DEADLINE_S" if on_accel else "RAFT_TPU_BENCH_CPU_DEADLINE_S"
+    )
     deadline = time.monotonic() + float(
-        os.environ.get(
-            "RAFT_TPU_BENCH_DEADLINE_S", _ACCEL_DEADLINE_S if on_accel else 600
-        )
+        os.environ.get(deadline_env, _ACCEL_DEADLINE_S if on_accel else 600)
     )
 
     # Clustered synthetic data (mixture of gaussians): real ANN corpora
@@ -235,7 +242,7 @@ def run_leg(leg: str) -> None:
     # --- IVF-PQ build (n_lists tracks n so probed rows stay ~constant as
     # the workload grows — the reference's ~n/250 rule of thumb)
     params = ivf_pq.IndexParams(
-        n_lists=max(1024, n // 250) if on_accel else 256,
+        n_lists=max(1024, n // 250) if on_accel else max(256, n // 64),
         metric="sqeuclidean",
         pq_dim=d // 2,
         pq_bits=8,
@@ -264,7 +271,8 @@ def run_leg(leg: str) -> None:
         return fn
 
     chosen = None
-    for n_probes in (4, 6, 8, 16, 32, 64, 128, 256):
+    # ladder ends at probe-all so the recall target is always reachable
+    for n_probes in (4, 6, 8, 16, 32, 64, 128, 256, params.n_lists):
         if n_probes > params.n_lists:
             break
         fn = make_search(n_probes)
@@ -302,11 +310,14 @@ def run_leg(leg: str) -> None:
         json.dumps(
             {
                 # keep the r1/r2 metric-name format (q1k etc.) when n_q is
-                # a whole number of thousands so history stays comparable
+                # a whole number of thousands so history stays comparable;
+                # the recall95 suffix is only claimed when the operating
+                # point actually reached it (deadline/exhaustion exits
+                # keep best-so-far and must not mislabel)
                 "metric": (
                     f"ivf_pq_qps_deep{n // 1000}k_q"
                     + (f"{n_q // 1000}k" if n_q % 1000 == 0 else f"{n_q}")
-                    + "_k10_recall95"
+                    + ("_k10_recall95" if recall >= 0.95 else "_k10_bestrecall")
                 ),
                 "value": round(qps, 1),
                 "unit": "queries/s",
