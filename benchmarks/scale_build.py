@@ -96,7 +96,7 @@ def main() -> None:
     # refine split, detail/refine_host-inl.hpp vs refine_device.cuh)
     from raft_tpu.neighbors.ivf_pq import _device_memory_budget
 
-    device_refine = x.nbytes <= 0.25 * _device_memory_budget()
+    device_refine = x.nbytes <= 0.25 * _device_memory_budget()[0]
     x_ref = jnp.asarray(x) if device_refine else x
     print(f"refine source: {'device' if device_refine else 'host (native)'}",
           flush=True)

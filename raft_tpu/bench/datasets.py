@@ -30,21 +30,32 @@ _DTYPES = {"fbin": np.float32, "u8bin": np.uint8, "i8bin": np.int8, "ibin": np.i
 
 
 def write_bin(path: str, arr: np.ndarray) -> None:
-    """big-ann binary writer: [n:int32][dim:int32][payload row-major]."""
-    arr = np.ascontiguousarray(arr)
+    """big-ann binary writer: [n:int32][dim:int32][payload row-major].
+    Memmap-backed inputs stream out in row chunks (100M-row slices never
+    materialize in RAM)."""
     with open(path, "wb") as fh:
         fh.write(np.asarray(arr.shape, np.int32).tobytes())
-        fh.write(arr.tobytes())
+        chunk = max(1, (1 << 28) // max(1, arr.shape[1] * arr.itemsize))
+        for i in range(0, arr.shape[0], chunk):
+            fh.write(np.ascontiguousarray(arr[i:i + chunk]).tobytes())
 
 
-def read_bin(path: str, dtype=None) -> np.ndarray:
+def read_bin(path: str, dtype=None, *, rows: Optional[int] = None,
+             mmap: bool = False) -> np.ndarray:
+    """Read a big-ann binary file. ``rows`` slices to the first ``rows``
+    vectors without materializing the rest (memmap-backed); ``mmap=True``
+    returns the mapping itself so billion-row files never enter RAM.
+    ``dtype`` should be passed explicitly when ``path`` doesn't carry the
+    big-ann extension (e.g. a ``.download`` temp name)."""
     if dtype is None:
         ext = path.rsplit(".", 1)[-1]
         dtype = _DTYPES.get(ext, np.float32)
     with open(path, "rb") as fh:
-        n, dim = np.frombuffer(fh.read(8), np.int32)
-        data = np.frombuffer(fh.read(), dtype)
-    return data.reshape(int(n), int(dim))
+        n, dim = (int(x) for x in np.frombuffer(fh.read(8), np.int32))
+    if rows is not None:
+        n = min(n, int(rows))
+    data = np.memmap(path, dtype, mode="r", offset=8, shape=(n, dim))
+    return data if mmap else np.asarray(data).copy()
 
 
 # --- TEXMEX .fvecs/.ivecs/.bvecs (sift/gist distributions: every row is
@@ -170,35 +181,85 @@ def synthetic(
     return Dataset(name=name, base=base, queries=queries, metric=metric)
 
 
+#: base chunk uploaded per groundtruth pass; memmap/huge bases stream
+#: through the device in pieces of this many bytes (float32-converted)
+_GT_BASE_CHUNK_BYTES = 1 << 30
+
+
 def generate_groundtruth(
     ds: Dataset, k: int = 100, *, batch: int = 2048,
     res: Optional[Resources] = None,
 ) -> Dataset:
     """Exact groundtruth via device brute force (ref: raft-ann-bench
-    generate_groundtruth — it likewise runs pylibraft brute_force on GPU)."""
+    generate_groundtruth — it likewise runs pylibraft brute_force on GPU).
+    Bases larger than ~1 GiB (e.g. the memmapped 100M-row big-ann slices)
+    are streamed through the device in row chunks with a host-side top-k
+    merge — the full base is never materialized on device."""
     res = ensure(res)
     import jax.numpy as jnp
 
-    base = jnp.asarray(ds.base)
-    dists, ids = [], []
-    for s in range(0, ds.queries.shape[0], batch):
-        v, i = brute_force.knn(
-            base, jnp.asarray(ds.queries[s : s + batch]), k,
-            metric=ds.metric, res=res,
-        )
-        dists.append(np.asarray(v))
-        ids.append(np.asarray(i))
-    ds.gt_distances = np.concatenate(dists)
-    ds.gt_neighbors = np.concatenate(ids)
+    f32_bytes = ds.base.shape[0] * ds.base.shape[1] * 4
+    if f32_bytes <= _GT_BASE_CHUNK_BYTES and not isinstance(ds.base, np.memmap):
+        base = jnp.asarray(ds.base)
+        dists, ids = [], []
+        for s in range(0, ds.queries.shape[0], batch):
+            v, i = brute_force.knn(
+                base, jnp.asarray(ds.queries[s : s + batch]), k,
+                metric=ds.metric, res=res,
+            )
+            dists.append(np.asarray(v))
+            ids.append(np.asarray(i))
+        ds.gt_distances = np.concatenate(dists)
+        ds.gt_neighbors = np.concatenate(ids)
+        return ds
+
+    n, d = ds.base.shape
+    rows = max(k, _GT_BASE_CHUNK_BYTES // (d * 4))
+    largest = ds.metric == "inner_product"
+    best_v = np.full((ds.queries.shape[0], k),
+                     -np.inf if largest else np.inf, np.float32)
+    best_i = np.full((ds.queries.shape[0], k), -1, np.int64)
+    for cs in range(0, n, rows):
+        chunk = jnp.asarray(np.ascontiguousarray(ds.base[cs:cs + rows],
+                                                 dtype=np.float32))
+        kk = min(k, int(chunk.shape[0]))
+        for s in range(0, ds.queries.shape[0], batch):
+            v, i = brute_force.knn(
+                chunk, jnp.asarray(ds.queries[s:s + batch], dtype=jnp.float32),
+                kk, metric=ds.metric, res=res,
+            )
+            cand_v = np.concatenate([best_v[s:s + batch], np.asarray(v)], 1)
+            cand_i = np.concatenate(
+                [best_i[s:s + batch], np.asarray(i).astype(np.int64) + cs], 1
+            )
+            key = -cand_v if largest else cand_v
+            part = np.argpartition(key, k - 1, axis=1)[:, :k]
+            order = np.argsort(np.take_along_axis(key, part, 1), 1)
+            top = np.take_along_axis(part, order, 1)
+            best_v[s:s + batch] = np.take_along_axis(cand_v, top, 1)
+            best_i[s:s + batch] = np.take_along_axis(cand_i, top, 1)
+    ds.gt_distances = best_v
+    ds.gt_neighbors = best_i.astype(np.int32)
     return ds
+
+
+#: big-ann extension for each storable vector dtype (reverse of _DTYPES)
+_EXTS = {np.dtype(np.float32): "fbin", np.dtype(np.uint8): "u8bin",
+         np.dtype(np.int8): "i8bin"}
 
 
 def save(ds: Dataset, directory: str) -> None:
     """Persist in the big-ann layout raft-ann-bench uses
-    (base.fbin / query.fbin / groundtruth.neighbors.ibin / ...distances.fbin)."""
+    (base.fbin / query.fbin / groundtruth.neighbors.ibin / ...distances.fbin).
+    uint8/int8 bases (bigann) keep their dtype and get the matching
+    extension (base.u8bin) so ``load``'s extension-driven dtype inference
+    round-trips."""
     os.makedirs(directory, exist_ok=True)
-    write_bin(os.path.join(directory, "base.fbin"), ds.base)
-    write_bin(os.path.join(directory, "query.fbin"), ds.queries)
+    for stem, arr in (("base", ds.base), ("query", ds.queries)):
+        ext = _EXTS.get(np.dtype(arr.dtype))
+        if ext is None:  # anything non-standard stores as float32
+            arr, ext = np.asarray(arr, np.float32), "fbin"
+        write_bin(os.path.join(directory, f"{stem}.{ext}"), arr)
     if ds.gt_neighbors is not None:
         write_bin(
             os.path.join(directory, "groundtruth.neighbors.ibin"),
@@ -210,12 +271,19 @@ def save(ds: Dataset, directory: str) -> None:
         )
 
 
-def load(directory: str, name: str = "", metric: str = "sqeuclidean") -> Dataset:
+def load(directory: str, name: str = "", metric: str = "sqeuclidean",
+         *, mmap: bool = False) -> Dataset:
     """Load a dataset directory in either standard layout: big-ann
-    (base.fbin/query.fbin/groundtruth.*.ibin) or TEXMEX
+    (base.{fbin,u8bin,i8bin}/query.*/groundtruth.*.ibin) or TEXMEX
     (<name>_base.fvecs / _query.fvecs / _groundtruth.ivecs, the sift-1M
-    distribution layout)."""
-    if not os.path.exists(os.path.join(directory, "base.fbin")):
+    distribution layout). ``mmap=True`` leaves the base on disk
+    (100M-row directories load instantly and stream on use)."""
+    base_path = next(
+        (p for e in ("fbin", "u8bin", "i8bin")
+         if os.path.exists(p := os.path.join(directory, f"base.{e}"))),
+        None,
+    )
+    if base_path is None:
         import glob as _glob
 
         bases = sorted(_glob.glob(os.path.join(directory, "*_base.*vecs")))
@@ -232,10 +300,12 @@ def load(directory: str, name: str = "", metric: str = "sqeuclidean") -> Dataset
             if os.path.exists(gt):
                 ds.gt_neighbors = read_vecs(gt).astype(np.int32)
             return ds
+        raise FileNotFoundError(f"no base.{{fbin,u8bin,i8bin}} in {directory}")
+    ext = base_path.rsplit(".", 1)[-1]
     ds = Dataset(
         name=name or os.path.basename(directory.rstrip("/")),
-        base=read_bin(os.path.join(directory, "base.fbin")),
-        queries=read_bin(os.path.join(directory, "query.fbin")),
+        base=read_bin(base_path, mmap=mmap),
+        queries=read_bin(os.path.join(directory, f"query.{ext}")),
         metric=metric,
     )
     gtn = os.path.join(directory, "groundtruth.neighbors.ibin")

@@ -19,12 +19,24 @@ import argparse
 import os
 import sys
 
+import numpy as np
+
 #: ann-benchmarks HDF5 mirrors (ref: raft-ann-bench get_dataset URLs)
 _ANN_BENCHMARKS_URL = "https://ann-benchmarks.com/{name}.hdf5"
-#: big-ann-benchmarks binary sources for the large datasets
-_BIGANN_URLS = {
-    "deep-100M": "https://storage.yandexcloud.net/yandex-research/ann-datasets/DEEP/base.1B.fbin",
-    "bigann-100M": "https://dl.fbaipublicfiles.com/billion-scale-ann-benchmarks/bigann/base.1B.u8bin",
+#: big-ann-benchmarks binary sources for the large datasets: base file,
+#: published disjoint query file, and the row count the "-100M" name promises
+#: (the files themselves hold the full 1B rows — we slice while streaming).
+_BIGANN_SOURCES = {
+    "deep-100M": (
+        "https://storage.yandexcloud.net/yandex-research/ann-datasets/DEEP/base.1B.fbin",
+        "https://storage.yandexcloud.net/yandex-research/ann-datasets/DEEP/query.public.10K.fbin",
+        100_000_000,
+    ),
+    "bigann-100M": (
+        "https://dl.fbaipublicfiles.com/billion-scale-ann-benchmarks/bigann/base.1B.u8bin",
+        "https://dl.fbaipublicfiles.com/billion-scale-ann-benchmarks/bigann/query.public.10K.u8bin",
+        100_000_000,
+    ),
 }
 
 
@@ -35,7 +47,8 @@ def fetch(name: str, out_dir: str, *, synthetic: bool = False,
     from raft_tpu.bench import datasets
 
     dest = os.path.join(out_dir, name)
-    if os.path.exists(os.path.join(dest, "base.fbin")):
+    if any(os.path.exists(os.path.join(dest, f"base.{e}"))
+           for e in ("fbin", "u8bin", "i8bin")):
         print(f"{dest} already present", file=sys.stderr)
         return dest
 
@@ -45,35 +58,69 @@ def fetch(name: str, out_dir: str, *, synthetic: bool = False,
         datasets.save(ds, dest)
         return dest
 
-    url = (
-        _BIGANN_URLS[name]
-        if name in _BIGANN_URLS
-        else _ANN_BENCHMARKS_URL.format(name=name)
-    )
-    tmp = os.path.join(out_dir, f"{name}.download")
-    os.makedirs(out_dir, exist_ok=True)
     import urllib.error
     import urllib.request
 
-    try:
-        print(f"downloading {url} ...", file=sys.stderr)
-        urllib.request.urlretrieve(url, tmp)  # nosec - benchmark data fetch
-    except (urllib.error.URLError, OSError) as e:
-        raise RuntimeError(
-            f"download failed ({e}); in an offline environment use "
-            "--synthetic for the deterministic stand-in with the same "
-            "geometry"
-        ) from e
-    if url.endswith(".hdf5"):
-        ds = datasets.load_hdf5(tmp, name=name)
-    else:
-        base = datasets.read_bin(tmp)
-        ds = datasets.Dataset(name=name, base=base, queries=base[:10_000],
+    def download(url: str, tmp: str, *, rows: int = 0, itemsize: int = 0) -> str:
+        """Fetch ``url`` into ``tmp``. With ``rows``, stream only the
+        first ``rows`` vectors of a big-ann binary file (the 1B-row source
+        files are sliceable prefixes — never transfer the other 90%) and
+        rewrite the header row count to match."""
+        try:
+            print(f"downloading {url} ...", file=sys.stderr)
+            if not rows:
+                urllib.request.urlretrieve(url, tmp)  # nosec - benchmark data
+                return tmp
+            with urllib.request.urlopen(url) as resp:  # nosec - benchmark data
+                header = resp.read(8)
+                n_total, dim = (int(v) for v in np.frombuffer(header, np.int32))
+                rows = min(rows, n_total)
+                remaining = rows * dim * itemsize
+                with open(tmp, "wb") as fh:
+                    fh.write(np.asarray([rows, dim], np.int32).tobytes())
+                    while remaining:
+                        chunk = resp.read(min(remaining, 1 << 24))
+                        if not chunk:
+                            raise RuntimeError(
+                                f"{url}: stream ended {remaining} bytes short"
+                            )
+                        fh.write(chunk)
+                        remaining -= len(chunk)
+        except (urllib.error.URLError, OSError) as e:
+            raise RuntimeError(
+                f"download failed ({e}); in an offline environment use "
+                "--synthetic for the deterministic stand-in with the same "
+                "geometry"
+            ) from e
+        return tmp
+
+    os.makedirs(out_dir, exist_ok=True)
+    tmps = []
+    if name in _BIGANN_SOURCES:
+        base_url, query_url, n_rows = _BIGANN_SOURCES[name]
+        # dtype comes from the SOURCE extension — the temp file's
+        # ".download" suffix would otherwise mis-infer u8bin as float32.
+        dtype = datasets._DTYPES[base_url.rsplit(".", 1)[-1]]
+        n_rows = max(1, int(n_rows * scale))
+        tmps.append(download(
+            base_url, os.path.join(out_dir, f"{name}.base.download"),
+            rows=n_rows, itemsize=np.dtype(dtype).itemsize,
+        ))
+        # memmap the sliced prefix — groundtruth + save both stream it
+        base = datasets.read_bin(tmps[0], dtype, mmap=True)
+        tmps.append(download(query_url, os.path.join(out_dir, f"{name}.query.download")))
+        queries = datasets.read_bin(tmps[1], dtype)
+        ds = datasets.Dataset(name=name, base=base, queries=queries,
                               metric="sqeuclidean")
+    else:
+        url = _ANN_BENCHMARKS_URL.format(name=name)
+        tmps.append(download(url, os.path.join(out_dir, f"{name}.download")))
+        ds = datasets.load_hdf5(tmps[0], name=name)
     if ds.gt_neighbors is None:
         ds = datasets.generate_groundtruth(ds, k=k)
     datasets.save(ds, dest)
-    os.remove(tmp)
+    for tmp in tmps:
+        os.remove(tmp)
     return dest
 
 

@@ -180,6 +180,7 @@ class NumpyExactANN(ANN):
     def build(self, dataset):
         self._x = np.ascontiguousarray(dataset, np.float32)
         self._x2 = (self._x.astype(np.float64) ** 2).sum(-1)
+        self._xn = np.sqrt(np.maximum(self._x2, 1e-30))
 
     def set_search_param(self, param):
         self._tile = int(param.get("tile", 2048))
@@ -192,8 +193,16 @@ class NumpyExactANN(ANN):
             qt = q[s : s + self._tile]
             if self.metric == "inner_product":
                 d = -(qt @ self._x.T)
+            elif self.metric == "cosine":
+                qn = np.sqrt(np.maximum((qt.astype(np.float64) ** 2)
+                                        .sum(-1), 1e-30))
+                d = 1.0 - (qt @ self._x.T) / (qn[:, None] * self._xn[None, :])
             else:
                 d = self._x2[None, :] - 2.0 * (qt @ self._x.T)
+                # +‖q‖² completes the true squared-L2 value: ranks don't
+                # need it, but frontier artifacts compare distance values
+                # across algorithms
+                d += (qt.astype(np.float64) ** 2).sum(-1)[:, None]
             part = np.argpartition(d, k - 1, axis=1)[:, :k]
             pv = np.take_along_axis(d, part, axis=1)
             order = np.argsort(pv, axis=1)

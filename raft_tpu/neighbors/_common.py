@@ -308,17 +308,20 @@ def select_scan_strategy(
         )
     if strategy != "probe_major":
         return strategy, None, None, None
-    reuse = max(1.0, (q * n_probes) / max(n_lists, 1))
-    bucket = int(np.clip(1 << int(np.ceil(np.log2(reuse))), 16, 512))
-    # per-step workspace: bb × (list rows + [G, cap] scores/ids + queries)
-    per_b = list_cap * (row_dim * 4 + bucket * 8) + bucket * row_dim * 4
-    bb = int(np.clip(workspace_bytes // max(per_b, 1), 1, 64))
     # merge-buffer bound: pair partials + bucket metadata ≈ 24 B per
     # (pair, k-slot); allow 4× the workspace for these transients. The
     # floor is the probe-major minimum batch (256) — NOT a bound override:
     # huge n_probes·k on a small workspace must still tile hard.
     per_q = max(1, n_probes * max(k, 1) * 24)
     q_tile = int(np.clip(4 * workspace_bytes // per_q, 256, max(q, 256)))
+    # bucket size comes from the reuse ratio of the ACTUAL per-call batch,
+    # min(q, q_tile) — sizing from the full q would leave tiles mostly -1
+    # padding (masked MXU slots) whenever q ≫ q_tile
+    reuse = max(1.0, (min(q, q_tile) * n_probes) / max(n_lists, 1))
+    bucket = int(np.clip(1 << int(np.ceil(np.log2(reuse))), 16, 512))
+    # per-step workspace: bb × (list rows + [G, cap] scores/ids + queries)
+    per_b = list_cap * (row_dim * 4 + bucket * 8) + bucket * row_dim * 4
+    bb = int(np.clip(workspace_bytes // max(per_b, 1), 1, 64))
     return strategy, bucket, bb, q_tile
 
 

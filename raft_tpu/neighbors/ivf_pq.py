@@ -98,22 +98,29 @@ _DECODED_DTYPES = {
 _AUTO_HBM_FRACTION = 0.55
 
 
-def _device_memory_budget() -> int:
-    """Bytes of accelerator memory to plan against. Real limit where the
-    backend reports one (TPU/GPU ``memory_stats``); `RAFT_TPU_HBM_BYTES`
-    overrides; 16 GiB (one v5e chip) when unknown (e.g. CPU)."""
+def _device_memory_budget() -> tuple[int, bool]:
+    """Bytes of accelerator memory to plan against, and whether that number
+    is a *real* reported limit (TPU/GPU ``memory_stats`` or the
+    ``RAFT_TPU_HBM_BYTES`` override) as opposed to the 16 GiB (one v5e
+    chip) assumption used when the backend reports nothing (e.g. CPU)."""
     import os
 
     env = os.environ.get("RAFT_TPU_HBM_BYTES")
     if env:
-        return int(env)
+        try:
+            return int(env), True
+        except ValueError as e:
+            raise ValueError(
+                f"RAFT_TPU_HBM_BYTES must be an integer byte count, got "
+                f"{env!r}"
+            ) from e
     try:
         stats = jax.local_devices()[0].memory_stats()
         if stats and stats.get("bytes_limit"):
-            return int(stats["bytes_limit"])
+            return int(stats["bytes_limit"]), True
     except Exception:
         pass
-    return 16 << 30
+    return 16 << 30, False
 
 #: HBM budget for the f32 intermediates of one decode chunk (the decode is
 #: chunked over lists so huge indexes — the int8 mode's reason to exist —
@@ -726,12 +733,27 @@ def build(
         # y2 + ids); 1.35 ≈ split/headroom padding allowance
         est_rows = int(n * 1.35) + 8 * params.n_lists
         bf16_bytes = est_rows * (rot_dim * 2 + pq_dim + 8)
-        budget = int(_AUTO_HBM_FRACTION * _device_memory_budget())
-        decoded_dtype = "bfloat16" if bf16_bytes <= budget else "int8"
+        total, limit_is_real = _device_memory_budget()
+        budget = int(_AUTO_HBM_FRACTION * total)
+        # int8 is an accuracy-class change: only auto-select it against a
+        # REAL reported device limit — the 16 GiB assumption on backends
+        # with no bytes_limit (CPU) must not silently degrade recall.
+        decoded_dtype = (
+            "int8" if bf16_bytes > budget and limit_is_real else "bfloat16"
+        )
         if decoded_dtype == "int8":
-            _log.info(
+            _log.warning(
                 "ivf_pq.build: projected bf16 cache %.1f GB exceeds %.1f GB "
-                "budget — auto-selecting int8 scan cache",
+                "budget — auto-selecting int8 scan cache (accuracy-class "
+                "change; pass decoded_dtype explicitly to override)",
+                bf16_bytes / 2**30, budget / 2**30,
+            )
+        elif bf16_bytes > budget:
+            _log.warning(
+                "ivf_pq.build: projected bf16 cache %.1f GB exceeds the "
+                "assumed %.1f GB budget but the backend reports no memory "
+                "limit — keeping bfloat16 (set decoded_dtype='int8' or "
+                "RAFT_TPU_HBM_BYTES to opt into the quantized cache)",
                 bf16_bytes / 2**30, budget / 2**30,
             )
     validation.check_in(decoded_dtype, _DECODED_DTYPES, "decoded_dtype")
