@@ -31,6 +31,13 @@ import time
 PROBE_TIMEOUT_S = 150
 PROBE_RETRIES = 2
 PROBE_BACKOFF_S = 10
+
+#: FROZEN CPU-fallback workload (since round 3; do not change). Cross-round
+#: comparability of BENCH_r*.json depends on the fallback leg measuring the
+#: exact same problem every round — only the accelerator workload may scale
+#: (RAFT_TPU_BENCH_N). Matches BENCH_r03.json: n=24k rows, d=96, 400
+#: queries, k=10, sqeuclidean, seed 0.
+_CPU_FALLBACK = {"n": 24_000, "d": 96, "n_q": 400, "k": 10}
 #: single source of the accelerator leg's wall-clock budget — the parent
 #: watchdog allows this plus a fixed margin, run_leg sweeps against it
 _ACCEL_DEADLINE_S = 1500
@@ -197,10 +204,10 @@ def run_leg(leg: str) -> None:
         n = int(os.environ.get("RAFT_TPU_BENCH_N", 500_000))
         d, n_q, k = 96, 10_000, 10
     else:
-        # sized so the index visibly beats exact brute force even on the
-        # fallback platform (vs_baseline > 1) while the whole leg stays
-        # inside the driver's patience (~4 min measured end to end)
-        n, d, n_q, k = 24_000, 96, 400, 10
+        # the FROZEN fallback workload (see _CPU_FALLBACK) — no env
+        # override, no re-tuning: the one job of this leg is to measure
+        # the same problem in every round
+        n, d, n_q, k = (_CPU_FALLBACK[x] for x in ("n", "d", "n_q", "k"))
     # hard wall-clock budget: emit the best-so-far operating point rather
     # than let a cold-compile sweep run into the driver's time cap
     # the CPU leg keeps its own (shorter) budget: main() setdefaults the

@@ -102,20 +102,18 @@ def shard_ivf_pq_index(comms: Comms, index) -> dict:
 
     axis = comms.axis
     centers = jnp.pad(index.centers, ((0, pad), (0, 0)))
-    list_data = index.list_data
-    list_y2 = index.list_y2
-    if list_data.dtype == jnp.int8:
-        # the sharded scan runs in the stored dtype; dequantize the int8
-        # memory-lean cache to bf16 here (each shard holds 1/size of it) and
-        # recompute y2 from the bf16-rounded values so scores keep matching
-        # exactly what the scan kernel sees
-        list_data = (list_data.astype(jnp.float32) * index.scan_scale).astype(
-            jnp.bfloat16
-        )
-        d32 = list_data.astype(jnp.float32)
-        list_y2 = jnp.sum(d32 * d32, axis=-1)
-    data = jnp.pad(list_data, ((0, pad), (0, 0), (0, 0)))
-    y2 = jnp.pad(list_y2, ((0, pad), (0, 0)))
+    # the int8 memory-lean cache shards AS int8 — each shard keeps its
+    # 1/size of the rot_dim-bytes/vector cache and the global scan_scale,
+    # and the sharded scan runs the same quantized-query recipe as the
+    # single-device kernel (dequantizing here would double every shard's
+    # bytes, defeating the mode on exactly the DEEP-100M-on-a-mesh
+    # configuration that needs both features)
+    scan_scale = (
+        float(index.scan_scale)
+        if index.list_data.dtype == jnp.int8 else 1.0
+    )
+    data = jnp.pad(index.list_data, ((0, pad), (0, 0), (0, 0)))
+    y2 = jnp.pad(index.list_y2, ((0, pad), (0, 0)))
     ids = jnp.pad(index.list_index, ((0, pad), (0, 0)), constant_values=-1)
     valid = jnp.arange(L_pad) < L
     return {
@@ -126,6 +124,7 @@ def shard_ivf_pq_index(comms: Comms, index) -> dict:
         "list_valid": dev_put(valid, P(axis)),
         "rotation": dev_put(index.rotation, P(None, None)),
         "metric": index.metric,
+        "scan_scale": scan_scale,
     }
 
 
@@ -148,6 +147,9 @@ def sharded_ivf_pq_search(
     ``lut_dtype`` mirrors the single-device SearchParams knob: "float32"
     (default) upcasts the stored rows for the scan so sharded distances
     match the single-device search; "bfloat16" halves the scan stream.
+    int8 (memory-lean) caches ignore it and run the quantized-query int8
+    MXU path with the index's global ``scan_scale`` — numerically identical
+    to the single-device int8 scan, at int8 bytes per shard.
     ``strategy`` selects each shard's local scan schedule (see
     ivf_pq.SearchParams.strategy — the probe-major schedule streams each
     local list from HBM once per bucket).
@@ -212,9 +214,28 @@ def sharded_ivf_pq_search(
 
         q_rot = jnp.matmul(q, rot.T, precision=_PREC)
         # scan compute dtype per lut_dtype (f32 upcast of the stored rows by
-        # default — the single-device kernel's knob); f32 accumulation
+        # default — the single-device kernel's knob); f32 accumulation.
+        # int8 caches instead ride the MXU's native int8 path with the
+        # SAME quantized-query recipe as the single-device scan
+        # (toolkit.quantize_queries_i8 + scan_scale rescale).
+        quantized = data_s.dtype == jnp.int8
+        scan_scale = sharded.get("scan_scale", 1.0)
         scan_dtype = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
         n_q = q.shape[0]
+
+        def scored_ip(qr, dec, batch_axes):
+            """q·y inner products in the cache's native dtype; int8 caches
+            ride the shared quantized-query recipe (toolkit.int8_scored_ip
+            — the same helper the single-device scans use)."""
+            if quantized:
+                from raft_tpu.kernels.toolkit import int8_scored_ip
+
+                return int8_scored_ip(qr, dec, batch_axes, scan_scale)
+            return lax.dot_general(
+                qr.astype(scan_dtype), dec.astype(scan_dtype), batch_axes,
+                preferred_element_type=jnp.float32,
+            )
+
         if local_strategy == "probe_major":
             # per-shard probe-major schedule (shared scaffold
             # _common.run_probe_major): each local list streams once per
@@ -227,11 +248,7 @@ def sharded_ivf_pq_search(
                 ids_b = ids_s[bl]
                 y2_b = y2_s[bl]
                 qr = q_rot[jnp.clip(bq, 0)]               # [bb, G, rot]
-                ip = lax.dot_general(
-                    qr.astype(scan_dtype), dec.astype(scan_dtype),
-                    (((2,), (2,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32,
-                )
+                ip = scored_ip(qr, dec, (((2,), (2,)), ((0,), (0,))))
                 if metric == "inner_product":
                     sc = -ip
                 else:
@@ -253,11 +270,7 @@ def sharded_ivf_pq_search(
             dec = data_s[probes]                          # [q, p, cap, rot]
             ids = ids_s[probes]                           # [q, p, cap]
             y2 = y2_s[probes]
-            ip = lax.dot_general(
-                q_rot.astype(scan_dtype), dec.astype(scan_dtype),
-                (((1,), (3,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            )
+            ip = scored_ip(q_rot, dec, (((1,), (3,)), ((0,), (0,))))
             if metric == "inner_product":
                 scores = -ip
             else:
@@ -302,6 +315,98 @@ def sharded_ivf_pq_search(
         )
 
     return run_query_tiled(run_tile, queries, max(1, query_tile))
+
+
+def sharded_ivf_pq_build(
+    comms: Comms,
+    x_sharded: jax.Array,
+    params,
+    *,
+    res=None,
+):
+    """MNMG IVF-PQ build — the raft-dask pattern (ref:
+    python/raft-dask/raft_dask/common/comms.py:172-212: workers share one
+    quantizer and index their local rows), TPU-native:
+
+    1. Train the coarse centroids + PQ codebooks ONCE on the trainset
+       subsample (the same deterministic kernels as the single-device
+       build — same seed → identical quantizers).
+    2. Run the O(n) predict+encode shard-locally under shard_map: each
+       device encodes its own rows against the replicated quantizer; only
+       the compressed stream (pq_dim B/row) leaves the devices.
+    3. Assemble the global list layout through the single-device seam
+       (``ivf_pq._extend_encoded``) — byte-identical to a single-device
+       build of the same rows, so searches are id-faithful.
+
+    ``x_sharded`` is the global [n, d] array, sharded (or shardable) on
+    the comms axis. Returns the assembled :class:`ivf_pq.Index`; pass it
+    to :func:`shard_ivf_pq_index` for distributed search (the full
+    build → search round trip runs in ``dryrun_multichip``).
+    """
+    from dataclasses import replace
+
+    from raft_tpu.cluster.kmeans_balanced import _predict_jit
+    from raft_tpu.core.resources import ensure as _ensure
+    from raft_tpu.distance.pairwise import argmin_tile_rows
+    from raft_tpu.neighbors import ivf_pq
+
+    mesh, axis = comms.mesh, comms.axis
+    size = comms.get_size()
+    n, dim = x_sharded.shape
+    x_sharded = jnp.asarray(x_sharded)
+
+    # 1) quantizer training (trainset-subsample-sized, like the reference's
+    # build — ivf_pq_build.cuh:1706-1766; the O(n) work is steps 2-3)
+    skel = ivf_pq.build(
+        replace(params, add_data_on_build=False), x_sharded, res=res
+    )
+
+    # 2) shard-local encode
+    kb_metric = (
+        "inner_product"
+        if DISTANCE_TYPES[params.metric] == "inner_product"
+        else "sqeuclidean"
+    )
+    tile_rows = argmin_tile_rows(skel.centers.shape[0], _ensure(res))
+    n_pad = -(-n // size) * size
+    if n_pad != n:
+        from jax.sharding import NamedSharding
+
+        x_sharded = jax.device_put(
+            jnp.pad(x_sharded, ((0, n_pad - n), (0, 0))),
+            NamedSharding(mesh, P(axis, None)),
+        )
+
+    def local(xs, centers, centers_rot, rotation, codebook):
+        xs = xs.astype(jnp.float32)
+        lt = _predict_jit(centers, xs, kb_metric, tile_rows)
+        codes = ivf_pq._encode(
+            rotation, centers, centers_rot, codebook, xs, lt,
+            skel.codebook_kind,
+        )
+        return codes, lt.astype(jnp.int32)
+
+    rep = P(*([None] * 2))
+    f = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), rep, rep, rep,
+                  P(*([None] * skel.codebook.ndim))),
+        out_specs=(P(axis, None), P(axis)),
+        check_vma=False,
+    )
+    codes, labels = f(
+        x_sharded, skel.centers, skel.centers_rot, skel.rotation,
+        skel.codebook,
+    )
+
+    # 3) assemble — only the compressed stream crosses to the host
+    return ivf_pq._extend_encoded(
+        skel,
+        np.asarray(codes)[:n],
+        np.asarray(labels)[:n],
+        jnp.arange(n, dtype=jnp.int32),
+    )
 
 
 def kmeans_step(

@@ -173,6 +173,28 @@ def quantize_queries_i8(q: jax.Array):
     return q_i8, sq
 
 
+def int8_scored_ip(qr: jax.Array, dec_i8: jax.Array, dims, scan_scale):
+    """q·y inner products against an int8 scan cache: per-row symmetric
+    quantization of ``qr`` (:func:`quantize_queries_i8`), int8×int8 MXU
+    dot with the given ``dot_general`` dimension numbers, f32 rescale by
+    (per-row scale × global ``scan_scale``). THE one copy of the XLA
+    int8-score recipe — the single-device query/probe-major scans and the
+    sharded scan all call this so they stay numerically identical to each
+    other and to the Pallas kernel's quantized leg."""
+    from jax import lax
+
+    q_i8, sq = quantize_queries_i8(qr)
+    ip_i32 = lax.dot_general(
+        q_i8, dec_i8, dims, preferred_element_type=jnp.int32
+    )
+    # sq is qr.shape[:-1] + (1,); right-pad axes so it broadcasts over the
+    # ip result's trailing (…, cap) dims
+    extra = ip_i32.ndim - sq.ndim
+    if extra:
+        sq = sq.reshape(sq.shape[:-1] + (1,) * (extra + 1))
+    return ip_i32.astype(jnp.float32) * (sq * scan_scale)
+
+
 def col_ids_tile(rows: int, tile_n: int, col_base) -> jax.Array:
     """Global column indices of a [rows, tile_n] tile starting at col_base
     (the vectorized-iota every tiled kernel needs)."""

@@ -73,7 +73,7 @@ from raft_tpu.neighbors._common import (
     select_scan_strategy,
     unpack_lists,
 )
-from raft_tpu.kernels.toolkit import quantize_queries_i8
+from raft_tpu.kernels.toolkit import int8_scored_ip, quantize_queries_i8
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
 from raft_tpu.core.logger import logger as _log
@@ -900,7 +900,20 @@ def extend(
     labels = (
         np.concatenate(label_parts) if label_parts else np.zeros((0,), np.int32)
     )
+    return _extend_encoded(index, codes, labels, new_indices)
 
+
+def _extend_encoded(
+    index: Index,
+    codes: np.ndarray,
+    labels: np.ndarray,
+    new_indices: Optional[jax.Array] = None,
+) -> Index:
+    """Append already-encoded rows (codes [n, pq_dim] uint8 + coarse
+    labels [n]) — the assembly half of :func:`extend`. The seam the
+    distributed build uses: shards encode their own rows in parallel, the
+    compressed streams meet here (pq_dim B/row is all that travels)."""
+    n = codes.shape[0]
     old_n = index.size
     if new_indices is None:
         new_indices = jnp.arange(old_n, old_n + n, dtype=jnp.int32)
@@ -1014,14 +1027,9 @@ def _search_jit(
             # memory-lean mode: rows are int8 × global scan_scale; quantize
             # the query per-row and ride the MXU's native int8 path, then
             # rescale the int32 accumulator (the fp8-LUT accuracy analog)
-            q_i8, sq = quantize_queries_i8(qr)
-            ip_i32 = lax.dot_general(
-                q_i8,
-                dec,
-                (((1,), (3,)), ((0,), (0,))),
-                preferred_element_type=jnp.int32,
+            ip = int8_scored_ip(
+                qr, dec, (((1,), (3,)), ((0,), (0,))), scan_scale
             )                                            # [t, p, cap]
-            ip = ip_i32.astype(jnp.float32) * (sq[:, :, None] * scan_scale)
         else:
             ip = lax.dot_general(
                 qr.astype(scan_dtype),
@@ -1104,12 +1112,9 @@ def _search_probe_major_jit(
         y2 = list_y2[bl]
         qr = q_rot[jnp.clip(bq, 0)]                                # [bb, G, rot]
         if list_data.dtype == jnp.int8:
-            q_i8, sqs = quantize_queries_i8(qr)
-            ip_i32 = lax.dot_general(
-                q_i8, dec, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.int32,
+            ip = int8_scored_ip(
+                qr, dec, (((2,), (2,)), ((0,), (0,))), scan_scale
             )                                                      # [bb, G, cap]
-            ip = ip_i32.astype(jnp.float32) * (sqs * scan_scale)
         else:
             ip = lax.dot_general(
                 qr.astype(scan_dtype), dec.astype(scan_dtype),
