@@ -72,13 +72,20 @@ def main() -> None:
                for p in (8, 16, 32) for r in (2, 4)],
         ),
         (
+            # deg-64 graph + entry-point-seeded w=1 walks — the winning
+            # region from the round-4 sweep (the old deg-32 w∈{2,4} grid
+            # never reached the pareto front; see ROUND4_NOTES)
             "raft_tpu_cagra",
-            {"graph_degree": 32, "intermediate_graph_degree": 64},
+            {"graph_degree": 64, "intermediate_graph_degree": 128},
             [
-                {"itopk_size": t, "search_width": w}
-                for t in (32, 64, 128)
-                for w in (2, 4)
-            ],
+                {"itopk_size": t, "search_width": 1, "max_iterations": mi,
+                 "num_entry_centers": s}
+                for t in (16, 32)
+                for mi in (3, 4, 6, 8)
+                for s in (8, 16)
+            ]
+            + [{"itopk_size": 64, "search_width": 1},
+               {"itopk_size": 64, "search_width": 4}],
         ),
         ("hnswlib_format", {"graph_degree": 32}, [{"ef": e} for e in (32, 64, 128)]),
     ]

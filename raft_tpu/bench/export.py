@@ -13,6 +13,7 @@ from raft_tpu.bench.runner import RunResult
 _FIELDS = [
     "algo", "dataset", "k", "build_param", "search_param",
     "build_time_s", "qps", "latency_ms", "recall", "end_to_end_s",
+    "device_time_s", "device_qps",
 ]
 
 

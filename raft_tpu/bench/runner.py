@@ -21,6 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.bench import device_time
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.bench.datasets import Dataset
 from raft_tpu.stats import neighborhood_recall
@@ -226,7 +227,11 @@ class HnswANN(ANN):
 
         self._hnsw = hnsw
         self._dim = dataset.shape[1]
-        params = cagra.IndexParams(metric=self.metric, **self.build_param)
+        # entry_points=0: the hnswlib layout stores only dataset+graph, so
+        # building cagra's entry table here would be discarded work
+        params = cagra.IndexParams(
+            metric=self.metric, **{"entry_points": 0, **self.build_param}
+        )
         built = cagra.build(params, jnp.asarray(dataset))
         # round-trip through the binary format so the comparator exercises
         # the interchange layout, not the in-memory index
@@ -297,6 +302,11 @@ class RunResult:
     latency_ms: float
     recall: float
     end_to_end_s: float
+    #: device-plane busy time for one search batch and the QPS it implies
+    #: (the reference's CUDA-event GPU time, benchmark.hpp:165,330-333);
+    #: None on host-only backends — never faked with wall time
+    device_time_s: Optional[float] = None
+    device_qps: Optional[float] = None
 
     def to_dict(self):
         return {
@@ -305,6 +315,8 @@ class RunResult:
             "build_time_s": self.build_time_s, "qps": self.qps,
             "latency_ms": self.latency_ms, "recall": self.recall,
             "end_to_end_s": self.end_to_end_s,
+            "device_time_s": self.device_time_s,
+            "device_qps": self.device_qps,
         }
 
 
@@ -346,6 +358,10 @@ def run_case(
         rec = float(
             neighborhood_recall(np.asarray(i), ds.gt_neighbors[:, :k])
         )
+        # device-side time for one batch (None off-accelerator)
+        dev_s = device_time.measure_device_time(
+            lambda qq: algo.search(qq, k), queries
+        )
         out.append(
             RunResult(
                 algo=algo_name, dataset=ds.name, k=k,
@@ -355,6 +371,8 @@ def run_case(
                 latency_ms=dt / nq * 1e3,
                 recall=rec,
                 end_to_end_s=dt,
+                device_time_s=dev_s,
+                device_qps=None if not dev_s else nq / dev_s,
             )
         )
     return out

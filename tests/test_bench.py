@@ -253,3 +253,42 @@ def test_numpy_exact_true_distance_values(rng):
         gtv = np.sort(sd.cdist(q, x, scipy_name), 1)[:, :5]
         np.testing.assert_allclose(vals, gtv, rtol=1e-4, atol=1e-6)
         assert (vals >= 0).all()
+
+
+class TestDeviceTime:
+    """Device-time counters (VERDICT r3 missing #7): the xplane wire
+    parser and its integration contract."""
+
+    def test_xplane_parser_on_live_trace(self, tmp_path):
+        """Parse a real jax.profiler dump: host planes parse cleanly and
+        carry nonzero busy time; device planes are absent on the CPU
+        backend so measure_device_time returns None (never a fake)."""
+        import glob
+
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.bench import device_time
+
+        x = jnp.asarray(np.random.rand(512, 512).astype(np.float32))
+        f = jax.jit(lambda a: (a @ a.T).sum())
+        jax.block_until_ready(f(x))
+        d = str(tmp_path / "trace")
+        with jax.profiler.trace(d):
+            jax.block_until_ready(f(x))
+        dumps = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+        assert dumps, "profiler produced no xplane dump"
+        planes = device_time.plane_busy_ps(open(dumps[0], "rb").read())
+        assert planes, "parser found no planes"
+        assert any(ps > 0 for ps in planes.values())
+        # CPU backend → no /device: plane → None
+        assert device_time.device_busy_seconds(d) is None
+        assert device_time.measure_device_time(f, x) is None
+
+    def test_run_case_carries_device_fields(self, ds):
+        rs = runner.run_case(ds, "raft_tpu_brute_force", {}, [{}], k=5)
+        d = rs[0].to_dict()
+        assert "device_time_s" in d and "device_qps" in d
+        # host-only backend: both null, and qps stays wall-based
+        assert d["device_time_s"] is None and d["device_qps"] is None
+        assert d["qps"] > 0
