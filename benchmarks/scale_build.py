@@ -76,10 +76,16 @@ def main() -> None:
     index = ivf_pq.build(params, x)
     jax.block_until_ready(index.list_data)
     build_s = time.time() - t0
+    # peak host RSS over the build (the streamed-assemble memory claim:
+    # host keeps the dataset + compressed code stream, never a padded
+    # decoded copy); ru_maxrss is KiB on Linux
+    import resource
+
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
     foot = helpers.index_memory_footprint(index)
     print(
         f"build {build_s:.0f}s; cache dtype {index.list_data.dtype}; "
-        f"index {foot['total']/2**30:.2f} GB",
+        f"index {foot['total']/2**30:.2f} GB; peak rss {peak_rss_gb:.2f} GB",
         flush=True,
     )
 
@@ -156,6 +162,7 @@ def main() -> None:
                 "decoded_dtype": str(np.dtype(index.list_data.dtype).name)
                 if index.list_data.dtype != "bfloat16" else "bfloat16",
                 "build_s": build_s,
+                "peak_rss_gb": peak_rss_gb,
                 "extend_100k_s": extend_s,
                 "index_bytes": foot["total"],
                 "search": results,

@@ -121,10 +121,17 @@ def measure_device_time(fn, *args) -> Optional[float]:
     try:
         jax.block_until_ready(args)
         try:
-            with jax.profiler.trace(tmp):
-                jax.block_until_ready(fn(*args))
+            trace = jax.profiler.trace(tmp)
+            trace.__enter__()
         except Exception:
+            # profiler unavailable (e.g. a second concurrent trace) — the
+            # counter degrades to null; a failure of fn itself must NOT be
+            # swallowed into the same null, so only the setup is guarded
             return None
+        try:
+            jax.block_until_ready(fn(*args))
+        finally:
+            trace.__exit__(None, None, None)
         return device_busy_seconds(tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -16,15 +16,25 @@ its shmem LUT + warp select; here the "LUT" is the decoded scan cache and
 the warp queue is the VMEM fold.
 
 Used by the ivf_pq AND ivf_flat probe-major paths when
-``RAFT_TPU_PALLAS=1`` (same gate as the fused kNN kernel).  Storage:
-f32/bf16 rows upcast in VMEM; ivf_pq's **int8 scan cache takes the fused
-quantized-query leg** (per-query symmetric quantization, int8×int8 MXU
-dot, scan_scale rescale — the memory-lean DEEP-100M mode).  Raw
-int8/uint8 ivf_flat datasets, filtered searches, and inner-product stay
-on the XLA schedule (bitset filter words don't fit VMEM at target
-scales).  The kernel is payload-agnostic: ivf_pq feeds decoded
-reconstructions + their norms, ivf_flat feeds raw rows + row norms.
-Validated in interpret mode on CPU plus a TPU-gated compile test.
+``RAFT_TPU_PALLAS=1`` (same gate as the fused kNN kernel).  Coverage
+(round 4 widened to match the reference's compute_similarity surface):
+
+- **Metrics**: L2 (sqeuclidean/euclidean) and **inner product**.
+- **Storage**: f32/bf16 rows upcast in VMEM; ivf_pq's **int8 scan cache
+  takes the fused quantized-query leg** (per-query symmetric
+  quantization, int8×int8 MXU dot, scan_scale rescale — the memory-lean
+  DEEP-100M mode).  Raw int8/uint8 ivf_flat datasets stay on the XLA
+  schedule (no dequant scale).
+- **Filters**: bitset sample filters ride as a *packed per-list word
+  table* ([L, ceil(cap/32)] uint32, n/8 bytes total — built by
+  ``pack_list_filter`` from the global bitset once per search call).
+  Each bucket DMAs its list's words (a few dozen bytes) and expands them
+  to a lane mask in VMEM — the global bitset itself never needs to fit
+  VMEM, which is what kept this leg XLA-only in round 3.
+
+The kernel is payload-agnostic: ivf_pq feeds decoded reconstructions +
+their norms, ivf_flat feeds raw rows + row norms.  Validated in interpret
+mode on CPU plus a TPU-gated compile test.
 """
 
 from __future__ import annotations
@@ -42,16 +52,38 @@ from raft_tpu.kernels.toolkit import fold_topk, quantize_queries_i8
 _WORST = float("inf")
 
 
-def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, qg_ref, q2_ref,
-                 scale_ref, vals_ref, out_ids_ref, *, kk: int):
+def pack_list_filter(list_index: jax.Array, filter_words: jax.Array):
+    """Pack the bitset pass/fail of every (list, slot) into per-list
+    uint32 words ([L, ceil(cap/32)]): bit j of word w covers slot
+    32·w + j.  One XLA gather over the [L, cap] id table — n/8 bytes of
+    output, so a DEEP-100M filter table is ~12 MB next to a ~10 GB scan
+    cache.  Padding slots (id < 0) pack as fail."""
+    L, cap = list_index.shape
+    safe = jnp.clip(list_index, 0)
+    word = filter_words[safe // 32]
+    bit = (word >> (safe % 32).astype(jnp.uint32)) & 1
+    ok = (bit == 1) & (list_index >= 0)                  # [L, cap] bool
+    cap_w = -(-cap // 32)
+    ok = jnp.pad(ok, ((0, 0), (0, cap_w * 32 - cap)))
+    ok = ok.reshape(L, cap_w, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    return jnp.sum(ok << shifts, axis=2).astype(jnp.uint32)
+
+
+def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
+                 q2_ref, scale_ref, vals_ref, out_ids_ref, *, kk: int,
+                 metric: str, filtered: bool):
     """One bucket: score its list's rows against its G queries, keep the
-    per-query top-kk.  dec/y2/ids blocks were selected by the prefetched
-    bucket_list (dynamic index_map); qg/q2 are the bucket's pre-gathered
-    rotated queries (+inf q2 marks padding slots).  An int8 dec block
-    takes the quantized-query path: per-query symmetric quantization in
-    VMEM, int8×int8 MXU dot with int32 accumulation, rescale by the
-    per-query scale × the cache's frozen scan_scale (scale_ref, SMEM) —
-    the memory-lean DEEP-100M mode's scoring, fused."""
+    per-query top-kk.  dec/y2/ids/filt blocks were selected by the
+    prefetched bucket_list (dynamic index_map); qg/q2 are the bucket's
+    pre-gathered rotated queries (+inf q2 marks padding slots).  An int8
+    dec block takes the quantized-query path: per-query symmetric
+    quantization in VMEM, int8×int8 MXU dot with int32 accumulation,
+    rescale by the per-query scale × the cache's frozen scan_scale
+    (scale_ref, SMEM) — the memory-lean DEEP-100M mode's scoring, fused.
+    ``metric`` picks the score: L2 (y² − 2ip + q²) or inner product
+    (−ip); ``filtered`` expands the list's packed filter words to a lane
+    mask and demotes failing slots."""
     G = qg_ref.shape[1]
     cap = dec_ref.shape[1]
     if dec_ref.dtype == jnp.int8:
@@ -71,9 +103,19 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, qg_ref, q2_ref,
             preferred_element_type=jnp.float32,
         )                                                # [G, cap]
     q2 = q2_ref[0, :]                                    # [G]
-    scores = y2_ref[0, :][None, :] - 2.0 * ip + q2[:, None]
+    if metric == "inner_product":
+        scores = -ip
+    else:
+        scores = y2_ref[0, :][None, :] - 2.0 * ip + q2[:, None]
     ids_row = ids_ref[0, :]                              # [cap]
     invalid = (ids_row < 0)[None, :] | jnp.isinf(q2)[:, None]
+    if filtered:
+        words = filt_ref[0, :]                           # [cap_w] uint32
+        cap_w = words.shape[0]
+        shifts = jax.lax.broadcasted_iota(jnp.uint32, (cap_w, 32), 1)
+        bits = (words[:, None] >> shifts) & 1            # [cap_w, 32]
+        passing = bits.reshape(cap_w * 32)[:cap] == 1    # [cap]
+        invalid = invalid | ~passing[None, :]
     scores = jnp.where(invalid, _WORST, scores)
     cand_i = jnp.broadcast_to(ids_row[None, :], (G, cap))
     run_v = jnp.full((G, kk), _WORST, jnp.float32)
@@ -85,7 +127,7 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, qg_ref, q2_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("kk", "interpret")
+    jax.jit, static_argnames=("kk", "metric", "interpret")
 )
 def ivf_scan_probe_major(
     bucket_list: jax.Array,   # [B] int32 — list id per bucket
@@ -96,15 +138,24 @@ def ivf_scan_probe_major(
     list_index: jax.Array,    # [L, cap] int32
     kk: int,
     *,
+    metric: str = "sqeuclidean",
+    list_filter: jax.Array | None = None,  # [L, ceil(cap/32)] uint32
     scan_scale: float = 1.0,  # int8 cache dequant scale (1.0 for floats)
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Returns per-bucket (vals [B, G, kk], ids [B, G, kk]) L2 partials —
-    feed them to _common.merge_probe_major_partials.  The caller supplies
-    the pre-gathered bucket queries (one [B, G, rot] HBM pass — tiny next
-    to the list stream this schedule saves)."""
+    """Returns per-bucket (vals [B, G, kk], ids [B, G, kk]) score partials
+    (L2 or −ip per ``metric``) — feed them to
+    _common.merge_probe_major_partials.  The caller supplies the
+    pre-gathered bucket queries (one [B, G, rot] HBM pass — tiny next to
+    the list stream this schedule saves) and, for filtered searches, the
+    ``pack_list_filter`` word table."""
     B, G, rot = q_gathered.shape
     L, cap, _ = list_data.shape
+    filtered = list_filter is not None
+    if not filtered:
+        # single-word dummy rides the same BlockSpec; the kernel skips it
+        list_filter = jnp.zeros((L, 1), jnp.uint32)
+    cap_w = list_filter.shape[1]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -115,6 +166,7 @@ def ivf_scan_probe_major(
             ),
             pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # y2
             pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # ids
+            pl.BlockSpec((1, cap_w), lambda b, bl: (bl[b], 0)),  # filter
             pl.BlockSpec((1, G, rot), lambda b, bl: (b, 0, 0)),  # queries
             pl.BlockSpec((1, G), lambda b, bl: (b, 0)),          # q2
             pl.BlockSpec(memory_space=pltpu.SMEM),               # scan_scale
@@ -125,7 +177,9 @@ def ivf_scan_probe_major(
         ],
     )
     vals, ids = pl.pallas_call(
-        functools.partial(_scan_kernel, kk=kk),
+        functools.partial(
+            _scan_kernel, kk=kk, metric=metric, filtered=filtered
+        ),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, G, kk), jnp.float32),
@@ -137,6 +191,7 @@ def ivf_scan_probe_major(
         list_data,
         list_y2,
         list_index,
+        list_filter,
         q_gathered,
         q2_gathered,
         jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),

@@ -344,22 +344,22 @@ def merge_probe_major_partials(vs, is_, bucket_pair, q, n_probes, kk, k):
 
 
 def pallas_scan_enabled(
-    metric: str, storage_dtype, filter_words, *, allow_int8: bool = False
+    metric: str, storage_dtype, *, allow_int8: bool = False
 ) -> bool:
     """ONE copy of the fused-Pallas-scan gate shared by ivf_pq and
-    ivf_flat: opt-in via RAFT_TPU_PALLAS=1, L2 metrics, float/bf16 storage
-    (the kernel upcasts in VMEM), unfiltered (bitset words don't fit VMEM
-    at target scales). ``allow_int8`` admits the quantized scan cache
-    (ivf_pq only — the kernel's int8 leg dequantizes by scan_scale, which
-    raw int8/uint8 ivf_flat datasets don't have)."""
+    ivf_flat: opt-in via RAFT_TPU_PALLAS=1, L2 + inner-product metrics,
+    float/bf16 storage (the kernel upcasts in VMEM). Filtered searches
+    ride the kernel's packed per-list word table (round 4 — see
+    kernels/ivf_scan.pack_list_filter). ``allow_int8`` admits the
+    quantized scan cache (ivf_pq only — the kernel's int8 leg dequantizes
+    by scan_scale, which raw int8/uint8 ivf_flat datasets don't have)."""
     import os
 
     dtypes = (jnp.float32, jnp.bfloat16) + ((jnp.int8,) if allow_int8 else ())
     return (
         os.environ.get("RAFT_TPU_PALLAS") == "1"
-        and metric in ("sqeuclidean", "euclidean")
+        and metric in ("sqeuclidean", "euclidean", "inner_product")
         and storage_dtype in dtypes
-        and filter_words is None
     )
 
 

@@ -512,12 +512,14 @@ def _search_probe_major_jit(
     static_argnames=("n_probes", "k", "metric", "bucket", "interpret"),
 )
 def _search_probe_major_pallas(
-    queries, centers, list_data, list_index, list_norms,
+    queries, centers, list_data, list_index, list_norms, list_filter,
     n_probes: int, k: int, metric: str, bucket: int, interpret: bool,
 ):
     """Probe-major schedule with the fused Pallas scan (kernels/
-    ivf_scan.py — payload-agnostic for L2: here y² = the stored row norms
-    and queries are unrotated). Scores + per-query top-k stay in VMEM."""
+    ivf_scan.py — payload-agnostic: here y² = the stored row norms and
+    queries are unrotated; inner product rides the kernel's −ip leg and
+    ``list_filter`` is the pre-packed per-list word table, packed once in
+    :func:`search`). Scores + per-query top-k stay in VMEM."""
     from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major
     from raft_tpu.neighbors._common import (
         invert_probes as _invert,
@@ -538,13 +540,15 @@ def _search_probe_major_pallas(
     norms = jnp.where(list_index >= 0, list_norms, 0.0)
     vals, ids = ivf_scan_probe_major(
         bucket_list, qg, q2g, list_data, norms, list_index, kk,
-        interpret=interpret,
+        metric=metric, list_filter=list_filter, interpret=interpret,
     )
     v, i = _merge(
         vals.reshape(B * G, kk), ids.reshape(B * G, kk),
         bucket_pair, q, n_probes, kk, k,
     )
-    if metric == "euclidean":
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
         v = jnp.sqrt(jnp.maximum(v, 0.0))
     return v, i
 
@@ -581,14 +585,21 @@ def search(
         index.list_cap, index.dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
-        if pallas_scan_enabled(canonical, index.list_data.dtype, fw):
+        if pallas_scan_enabled(canonical, index.list_data.dtype):
             from raft_tpu.kernels import interpret_mode
+            from raft_tpu.kernels.ivf_scan import pack_list_filter
+
+            # pack the filter ONCE per call (query-independent)
+            lf = (
+                None if fw is None
+                else pack_list_filter(index.list_index, fw)
+            )
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
                     qt, index.centers, index.list_data, index.list_index,
-                    index.list_norms, n_probes, int(k), canonical, bucket,
-                    interpret_mode(),
+                    index.list_norms, lf, n_probes, int(k), canonical,
+                    bucket, interpret_mode(),
                 )
         else:
             def run_pm(qt):

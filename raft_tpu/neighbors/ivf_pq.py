@@ -1154,15 +1154,18 @@ def _search_probe_major_jit(
 )
 def _search_probe_major_pallas(
     queries, centers, rotation, list_data, list_y2, list_index,
-    scan_scale, n_probes: int, k: int, metric: str, bucket: int,
-    interpret: bool,
+    list_filter, scan_scale, n_probes: int, k: int, metric: str,
+    bucket: int, interpret: bool,
 ):
     """Probe-major schedule with the fused Pallas scan
     (kernels/ivf_scan.py): per-bucket list rows DMA into VMEM via the
     scalar-prefetched bucket table, scores + per-query top-k stay in VMEM —
     the [B, G, cap] score tensor never reaches HBM (the XLA formulation's
-    remaining traffic). L2 metrics, float or int8 caches (the kernel's
-    quantized-query leg handles int8 × scan_scale), unfiltered."""
+    remaining traffic). L2 + inner-product, float or int8 caches (the
+    kernel's quantized-query leg handles int8 × scan_scale);
+    ``list_filter`` is the pre-packed per-list word table (packed ONCE in
+    :func:`search` — it's query-independent, so packing here would redo
+    the O(n) pass per query tile)."""
     from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major
     from raft_tpu.neighbors._common import (
         invert_probes as _invert,
@@ -1181,13 +1184,16 @@ def _search_probe_major_pallas(
     q2g = jnp.where(bucket_query >= 0, q2[jnp.clip(bucket_query, 0)], jnp.inf)
     vals, ids = ivf_scan_probe_major(
         bucket_list, qg, q2g, list_data, list_y2, list_index, kk,
-        scan_scale=scan_scale, interpret=interpret,
+        metric=metric, list_filter=list_filter, scan_scale=scan_scale,
+        interpret=interpret,
     )
     v, i = _merge(
         vals.reshape(B * G, kk), ids.reshape(B * G, kk),
         bucket_pair, q, n_probes, kk, k,
     )
-    if metric == "euclidean":
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
         v = jnp.sqrt(jnp.maximum(v, 0.0))
     return v, i
 
@@ -1232,14 +1238,21 @@ def search(
     )
     if strategy == "probe_major":
         if pallas_scan_enabled(
-            canonical, index.list_data.dtype, fw, allow_int8=True
+            canonical, index.list_data.dtype, allow_int8=True
         ):
             from raft_tpu.kernels import interpret_mode
+            from raft_tpu.kernels.ivf_scan import pack_list_filter
+
+            # pack the filter ONCE per call (query-independent)
+            lf = (
+                None if fw is None
+                else pack_list_filter(index.list_index, fw)
+            )
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
                     qt, index.centers, index.rotation, index.list_data,
-                    index.list_y2, index.list_index,
+                    index.list_y2, index.list_index, lf,
                     float(index.scan_scale), n_probes, int(k),
                     canonical, bucket, interpret_mode(),
                 )

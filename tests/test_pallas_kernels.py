@@ -220,45 +220,83 @@ class TestIvfScanKernel:
             np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
         )
 
-    def test_pallas_gate_exclusions(self, monkeypatch):
+    def test_filtered_matches_xla(self, monkeypatch):
+        """Round 4: bitset filters ride the kernel's packed per-list word
+        table — the filtered Pallas scan must agree with the filtered XLA
+        schedule and never surface a filtered-out id."""
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_pq
+
+        index, x = self._index(n=4000)
+        q = jnp.asarray(x[:300])
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="probe_major")
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        v_x, i_x = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        i_p_np = np.asarray(i_p)
+        assert (i_p_np[i_p_np >= 0] % 2 == 0).all()
+        assert (np.asarray(i_x) == i_p_np).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+
+    def test_inner_product_matches_xla(self, monkeypatch):
+        """Round 4: the kernel's −ip leg must agree with the XLA
+        inner-product probe-major schedule."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(1)
+        xi, _, _ = make_blobs(key, 6000, 32, n_clusters=24, cluster_std=2.0)
+        xi = np.asarray(xi)
+        idx_ip = ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=24, pq_dim=16, kmeans_n_iters=4,
+                metric="inner_product",
+            ),
+            xi,
+        )
+        q = jnp.asarray(xi[:300] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="probe_major")
+        v_x, i_x = ivf_pq.search(sp, idx_ip, q, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, idx_ip, q, 10)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+
+    def test_filtered_int8_matches_xla(self, monkeypatch):
+        """Composition: int8 quantized cache × bitset filter through the
+        kernel — the DEEP-100M memory-lean mode with a sample filter."""
         from raft_tpu.core.bitset import Bitset
         from raft_tpu.neighbors import ivf_pq
         from raft_tpu.random import make_blobs
 
-        index, x = self._index(n=4000)
-        q = jnp.asarray(x[:300])
-        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
-
-        # every excluded leg must route to the XLA schedule, never the
-        # kernel — a dropped gate condition would skip the filter or score
-        # the wrong similarity (int8 caches are a SUPPORTED leg now — the
-        # kernel dequantizes by scan_scale; covered by
-        # test_int8_cache_matches_xla)
-        def boom(*a, **k):
-            raise AssertionError("Pallas path taken for an excluded case")
-
-        monkeypatch.setattr(ivf_pq, "_search_probe_major_pallas", boom)
-        sp = ivf_pq.SearchParams(n_probes=8, strategy="probe_major")
-
-        # (a) filtered search: XLA path + filter honored
-        mask = np.zeros(x.shape[0], bool)
-        mask[::2] = True
-        bs = Bitset.from_mask(jnp.asarray(mask))
-        _, ids = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
-        ids = np.asarray(ids)
-        assert (ids[ids >= 0] % 2 == 0).all()
-
-        # (b) inner-product metric: XLA path
-        key = jax.random.PRNGKey(1)
-        xi, _, _ = make_blobs(key, 4000, 32, n_clusters=16)
-        idx_ip = ivf_pq.build(
+        key = jax.random.PRNGKey(7)
+        x, _, _ = make_blobs(key, 6000, 32, n_clusters=24, cluster_std=2.0)
+        x = np.asarray(x)
+        index = ivf_pq.build(
             ivf_pq.IndexParams(
-                n_lists=16, pq_dim=16, kmeans_n_iters=3,
-                metric="inner_product",
+                n_lists=24, pq_dim=16, kmeans_n_iters=4,
+                decoded_dtype="int8",
             ),
-            np.asarray(xi),
+            x,
         )
-        ivf_pq.search(sp, idx_ip, q, 5)
+        q = jnp.asarray(x[:300] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="probe_major")
+        mask = np.zeros(x.shape[0], bool)
+        mask[1::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        v_x, i_x = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        i_p_np = np.asarray(i_p)
+        assert (i_p_np[i_p_np >= 0] % 2 == 1).all()
+        assert (np.asarray(i_x) == i_p_np).mean() >= 0.99
 
     def test_ivf_flat_pallas_matches_xla(self, monkeypatch):
         from raft_tpu.neighbors import ivf_flat
@@ -280,7 +318,9 @@ class TestIvfScanKernel:
             np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
         )
 
-    def test_ivf_flat_gate_excludes_filters(self, monkeypatch):
+    def test_ivf_flat_filtered_and_ip_match_xla(self, monkeypatch):
+        """Round 4: ivf_flat's filtered and inner-product probe-major
+        searches ride the widened kernel and must agree with XLA."""
         from raft_tpu.core.bitset import Bitset
         from raft_tpu.neighbors import ivf_flat
         from raft_tpu.random import make_blobs
@@ -292,6 +332,41 @@ class TestIvfScanKernel:
             ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3), x
         )
         q = jnp.asarray(x[:300])
+        sp = ivf_flat.SearchParams(n_probes=8, strategy="probe_major")
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        v_x, i_x = ivf_flat.search(sp, index, q, 5, sample_filter=bs)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_flat.search(sp, index, q, 5, sample_filter=bs)
+        i_p_np = np.asarray(i_p)
+        assert (i_p_np[i_p_np >= 0] % 2 == 0).all()
+        assert (np.asarray(i_x) == i_p_np).mean() >= 0.99
+        # inner product through the kernel's −ip leg
+        idx_ip = ivf_flat.build(
+            ivf_flat.IndexParams(
+                n_lists=16, kmeans_n_iters=3, metric="inner_product"
+            ), x,
+        )
+        monkeypatch.delenv("RAFT_TPU_PALLAS")
+        v_xi, i_xi = ivf_flat.search(sp, idx_ip, q, 5)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_pi, i_pi = ivf_flat.search(sp, idx_ip, q, 5)
+        assert (np.asarray(i_xi) == np.asarray(i_pi)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_xi), np.asarray(v_pi), rtol=2e-3, atol=1e-3
+        )
+
+    def test_ivf_flat_gate_excludes_cosine_and_raw_int8(self, monkeypatch):
+        """Remaining exclusions: cosine and raw int8 datasets (no dequant
+        scale) must still route to the XLA schedule."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(3)
+        x, _, _ = make_blobs(key, 4000, 16, n_clusters=16, cluster_std=2.0)
+        x = np.asarray(x)
+        q = jnp.asarray(x[:300])
         monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
 
         def boom(*a, **k):
@@ -299,17 +374,15 @@ class TestIvfScanKernel:
 
         monkeypatch.setattr(ivf_flat, "_search_probe_major_pallas", boom)
         sp = ivf_flat.SearchParams(n_probes=8, strategy="probe_major")
-        mask = np.zeros(x.shape[0], bool)
-        mask[::2] = True
-        bs = Bitset.from_mask(jnp.asarray(mask))
-        _, ids = ivf_flat.search(sp, index, q, 5, sample_filter=bs)
-        ids = np.asarray(ids)
-        assert (ids[ids >= 0] % 2 == 0).all()
-        # cosine metric routes to the XLA schedule too
         idx_cos = ivf_flat.build(
             ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3, metric="cosine"), x
         )
         ivf_flat.search(sp, idx_cos, q, 5)
+        x8 = (x * 10).astype(np.int8)
+        idx_i8 = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3), x8
+        )
+        ivf_flat.search(sp, idx_i8, q, 5)
 
     def test_int8_cache_matches_xla(self, monkeypatch):
         """The kernel's quantized-query int8 leg (the memory-lean
