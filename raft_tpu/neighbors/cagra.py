@@ -74,7 +74,10 @@ class IndexParams:
     metric: str = "sqeuclidean"
     intermediate_graph_degree: int = 128
     graph_degree: int = 64
-    build_algo: str = "auto"       # auto | ivf_pq | nn_descent | brute_force
+    #: auto | ivf_pq | nn_descent | nn_descent_batch | brute_force
+    #: (nn_descent_batch = out-of-core clustered graph build,
+    #: ref nn_descent_batch.cuh)
+    build_algo: str = "auto"
     nn_descent_niter: int = 20
     seed: int = 0
     entry_points: Optional[int] = None
@@ -291,8 +294,12 @@ def build(
     """(ref: cagra_build.cuh build: build_knn_graph → sort → optimize)"""
     res = ensure(res)
     # keep the dataset in its input dtype (f32/bf16/int8/uint8 — ref CAGRA
-    # dtype templates cagra_types.hpp:142); search casts gathered rows only
-    dataset = jnp.asarray(dataset)
+    # dtype templates cagra_types.hpp:142); search casts gathered rows
+    # only. A host numpy dataset stays host-side until after the graph
+    # build so the out-of-core path (nn_descent_batch) never uploads it
+    # wholesale; the final index upload happens once, below.
+    if not isinstance(dataset, np.ndarray):
+        dataset = jnp.asarray(dataset)
     n, d = dataset.shape
     metric = DISTANCE_TYPES[params.metric]
     if metric not in ("sqeuclidean", "euclidean", "inner_product"):
@@ -307,7 +314,7 @@ def build(
     if algo == "brute_force":
         g = nn_descent.build_exact(dataset, inter, metric=params.metric, res=res)
         knn_graph = g.graph
-    elif algo == "nn_descent":
+    elif algo in ("nn_descent", "nn_descent_batch"):
         nnd = nn_descent.IndexParams(
             graph_degree=inter,
             intermediate_graph_degree=min(n - 1, max(inter + inter // 2, inter + 8)),
@@ -315,7 +322,14 @@ def build(
             metric=params.metric,
             seed=params.seed,
         )
-        knn_graph = nn_descent.build(nnd, dataset, res=res).graph
+        if algo == "nn_descent_batch":
+            # out-of-core graph build: clustered per-batch GNND + merge
+            # (ref: nn_descent_batch.cuh — datasets beyond device memory)
+            knn_graph = nn_descent.build_batch(
+                nnd, np.asarray(dataset), res=res
+            ).graph
+        else:
+            knn_graph = nn_descent.build(nnd, dataset, res=res).graph
     elif algo == "ivf_pq":
         # ref cagra_build.cuh:47-201: ivf_pq build → per-row search with
         # gpu_top_k = degree * refine_rate → exact refine → drop self
@@ -346,6 +360,9 @@ def build(
         raise ValueError(f"unknown build_algo {params.build_algo}")
 
     graph = optimize(knn_graph, degree, res=res)
+    # the index itself is device-resident (search gathers from it); a
+    # host build input uploads exactly once, here
+    dataset = jnp.asarray(dataset)
     n_entries = params.entry_points
     if n_entries is None:
         n_entries = _auto_entry_points(n)

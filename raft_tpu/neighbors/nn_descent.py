@@ -206,6 +206,175 @@ def build(
     return Index(graph=graph_ids[:, :deg], distances=graph_dists[:, :deg])
 
 
+@traced("nn_descent.build_batch")
+def build_batch(
+    params: IndexParams,
+    dataset: np.ndarray,
+    *,
+    n_clusters: int = 0,
+    max_cluster_rows: int = 65_536,
+    res: Optional[Resources] = None,
+) -> Index:
+    """Out-of-core NN-descent for datasets that don't fit device memory
+    (ref: nn_descent_batch.cuh batch_build): balanced-kmeans cluster the
+    dataset, assign every row to its TOP-2 clusters (the overlap is what
+    stitches neighborhoods across cluster borders), run the in-memory
+    GNND per cluster, and merge each cluster's local graph into a global
+    host-resident graph row by row.
+
+    TPU shape discipline: clusters are padded to ONE common row count
+    (balanced kmeans keeps them near-equal) so every per-cluster GNND and
+    every merge reuses a single compiled program; padding rows are a far
+    sentinel vector (global id −1) that can never enter a real row's
+    neighbor list. Peak device residency = one padded cluster + its local
+    graph, independent of n.
+
+    ``dataset`` should be a host numpy array (a memmap works — rows are
+    gathered per cluster); L2 metrics only (the far-sentinel padding has
+    no inner-product analog).
+    """
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.neighbors._common import subsample_trainset
+
+    res = ensure(res)
+    metric = DISTANCE_TYPES[params.metric]
+    if metric not in ("sqeuclidean", "euclidean"):
+        raise ValueError(
+            f"build_batch supports L2 metrics, got {params.metric}"
+        )
+    dataset = np.asarray(dataset)
+    n, d = dataset.shape
+    # each row lands in 2 clusters → rows/cluster ≈ 2n/c
+    n_clusters = n_clusters or max(1, -(-2 * n // max_cluster_rows))
+    if n_clusters <= 1:
+        return build(params, jnp.asarray(dataset), res=res)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def _top2(xt, c):
+        c2 = jnp.sum(c * c, axis=1)
+        sc = c2[None, :] - 2.0 * jnp.matmul(xt, c.T, precision=_PREC)
+        _, top = select_k(sc, 2, select_min=True)
+        return top
+
+    kb = kmeans_balanced.KMeansBalancedParams(
+        n_iters=10, metric="sqeuclidean", seed=params.seed
+    )
+    # 1-2) centroids from a subsample (ref get_balanced_kmeans_centroids)
+    # + streamed top-2 cluster assignment (ref get_global_nearest_k, k=2).
+    # When top-2 skew leaves a cluster over budget, RE-SPLIT with more
+    # clusters (the reference's resplit) rather than blindly chunking —
+    # a chunk boundary would sever intra-cluster neighborhoods.
+    for attempt in range(3):
+        n_train = min(n, max(n_clusters * 64, 16_384))
+        train = subsample_trainset(dataset, n_train, params.seed) \
+            if n_train < n else jnp.asarray(dataset)
+        centers = kmeans_balanced.fit(
+            kb, train.astype(jnp.float32), n_clusters, res=res
+        )
+        tile = max(1, res.workspace_rows(4 * (n_clusters + d), cap=1 << 17))
+        top2 = np.empty((n, 2), np.int32)
+        absmax = 0.0  # dataset-wide |x| peak, same stream
+        for s in range(0, n, tile):
+            xt_np = np.asarray(dataset[s:s + tile], np.float32)
+            absmax = max(absmax, float(np.abs(xt_np).max()))
+            top2[s:s + tile] = np.asarray(_top2(jnp.asarray(xt_np), centers))
+        counts = np.bincount(top2.reshape(-1), minlength=n_clusters)
+        if int(counts.max()) <= max_cluster_rows or n_clusters >= n:
+            break
+        n_clusters = min(
+            n, int(np.ceil(n_clusters * counts.max() / max_cluster_rows
+                           * 1.25)),
+        )
+
+    # 3) inverted indices (host)
+    flat = top2.reshape(-1)
+    rows_of = np.repeat(np.arange(n, dtype=np.int64), 2)
+    order = np.argsort(flat, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+
+    # 4) one padded shape for every batch; clusters still over budget
+    # after the re-split attempts fall back to pad_m-row chunks (bounded
+    # residency wins over edge quality in that corner)
+    pad_m = int(min(
+        n,
+        -(-int(counts.max()) // 1024) * 1024,
+        -(-max_cluster_rows // 1024) * 1024,
+    ))
+    # far sentinel from the dataset-wide peak (a single-row estimate can
+    # land inside the cloud and corrupt neighbor lists)
+    sentinel = np.full(
+        (d,), 4.0 * (absmax + 1.0) * max(1.0, np.sqrt(d)), np.float32
+    )
+
+    k_out = min(
+        params.graph_degree, params.intermediate_graph_degree,
+        pad_m - 1, n - 1,
+    )
+    g_ids = np.full((n, k_out), -1, np.int32)
+    g_dists = np.full((n, k_out), np.inf, np.float32)
+
+    local_params = IndexParams(
+        graph_degree=k_out,
+        intermediate_graph_degree=min(
+            params.intermediate_graph_degree, pad_m - 1
+        ),
+        max_iterations=params.max_iterations,
+        termination_threshold=params.termination_threshold,
+        metric=params.metric,
+        sample_size=params.sample_size,
+        seed=params.seed,
+    )
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def _merge(gi, gd, ci, cd, k: int):
+        ids, dists, _ = _merge_dedup(gi, gd, ci, cd, k)
+        return ids, dists
+
+    batches = []
+    for cid in range(n_clusters):
+        all_rows = rows_of[order[starts[cid]:starts[cid + 1]]]
+        for cs in range(0, all_rows.shape[0], pad_m):
+            batches.append(all_rows[cs:cs + pad_m])
+    for rows in batches:
+        m = rows.shape[0]
+        if m == 0:
+            continue
+        xc = np.empty((pad_m, d), np.float32)
+        xc[:m] = dataset[rows]
+        xc[m:] = sentinel
+        # ref build_and_merge: local GNND on the cluster subset
+        local = build(local_params, jnp.asarray(xc), res=res)
+        li = np.asarray(local.graph)                     # [pad_m, k] local
+        ld = np.asarray(local.distances)
+        # map local → global; sentinel/padding neighbors drop to −1
+        gi_cand = np.full((pad_m, k_out), -1, np.int32)
+        gi_cand[:m] = np.where(
+            (li[:m] >= 0) & (li[:m] < m), rows[np.clip(li[:m], 0, m - 1)], -1
+        )
+        ld = np.where(gi_cand >= 0, ld, np.inf).astype(np.float32)
+        # a row may appear in both of its clusters under its own id —
+        # merge dedup keeps the best copy (ref merge_subgraphs). The
+        # merge runs at the padded shape too (one compiled program).
+        old_i = np.full((pad_m, k_out), -1, np.int32)
+        old_d = np.full((pad_m, k_out), np.inf, np.float32)
+        old_i[:m] = g_ids[rows]
+        old_d[:m] = g_dists[rows]
+        mi, md = _merge(
+            jnp.asarray(old_i), jnp.asarray(old_d),
+            jnp.asarray(gi_cand), jnp.asarray(ld), k_out,
+        )
+        g_ids[rows] = np.asarray(mi)[:m]
+        g_dists[rows] = np.asarray(md)[:m]
+    # self edges can sneak in via the duplicate cluster memberships
+    self_col = g_ids == np.arange(n, dtype=np.int32)[:, None]
+    g_dists = np.where(self_col, np.inf, g_dists)
+    g_ids = np.where(self_col, -1, g_ids)
+    order2 = np.argsort(g_dists, axis=1, kind="stable")
+    g_ids = np.take_along_axis(g_ids, order2, axis=1)
+    g_dists = np.take_along_axis(g_dists, order2, axis=1)
+    return Index(graph=jnp.asarray(g_ids), distances=jnp.asarray(g_dists))
+
+
 def build_exact(
     dataset: jax.Array, graph_degree: int, metric: str = "sqeuclidean",
     *, res: Optional[Resources] = None,

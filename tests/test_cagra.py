@@ -306,3 +306,59 @@ class TestEntryPoints:
         _, ids = cagra.search(sp, built, q, 10, sample_filter=bs)
         ids = np.asarray(ids)
         assert ((ids % 2 == 0) | (ids == -1)).all()
+
+
+class TestBatchNNDescent:
+    """Out-of-core NN-descent (ref nn_descent_batch.cuh): clustered
+    per-batch GNND + global merge; CAGRA graph builds at sizes the
+    in-memory path cannot hold."""
+
+    def test_batch_graph_recall(self):
+        key = jax.random.PRNGKey(21)
+        x, _, _ = make_blobs(key, 6000, 24, n_clusters=32, cluster_std=2.0)
+        x = np.asarray(x)
+        p = nn_descent.IndexParams(
+            graph_degree=24, intermediate_graph_degree=36, max_iterations=10
+        )
+        # max_cluster_rows forces ~6 overlapping clusters (the out-of-core
+        # path) even though the data would fit in memory
+        g = nn_descent.build_batch(p, x, max_cluster_rows=2048)
+        gi = np.asarray(g.graph)
+        n = x.shape[0]
+        assert gi.shape == (n, 24)
+        assert (gi < n).all()
+        assert (gi != np.arange(n)[:, None]).all()
+        _, gt = brute_force.knn(x, x, 25)
+        gt = np.asarray(gt)[:, 1:]
+        sub = range(0, n, 10)
+        rec = np.mean([
+            len(np.intersect1d(gi[i], gt[i])) / 24 for i in sub
+        ])
+        assert rec >= 0.8, rec
+        # distances are the true metric values for the reported neighbors
+        gd = np.asarray(g.distances)
+        i0 = gi[0]
+        want = ((x[0][None] - x[i0]) ** 2).sum(-1)
+        np.testing.assert_allclose(gd[0], want, rtol=1e-3, atol=1e-3)
+
+    def test_cagra_build_algo_batch(self):
+        key = jax.random.PRNGKey(22)
+        x, _, _ = make_blobs(key, 5000, 24, n_clusters=25, cluster_std=2.0)
+        x = np.asarray(x)
+        rng = np.random.default_rng(3)
+        q = x[rng.choice(x.shape[0], 48, replace=False)] + 0.01
+        idx = cagra.build(
+            cagra.IndexParams(
+                intermediate_graph_degree=36, graph_degree=24,
+                build_algo="nn_descent_batch",
+            ), x,
+        )
+        _, gt = brute_force.knn(x, q, 10)
+        _, ids = cagra.search(cagra.SearchParams(itopk_size=32), idx, q, 10)
+        r = float(neighborhood_recall(np.asarray(ids), np.asarray(gt)))
+        assert r >= 0.9, r
+
+    def test_batch_rejects_inner_product(self):
+        p = nn_descent.IndexParams(metric="inner_product")
+        with pytest.raises(ValueError, match="L2"):
+            nn_descent.build_batch(p, np.zeros((100, 8), np.float32))
