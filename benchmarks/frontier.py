@@ -89,6 +89,10 @@ def main() -> None:
         ),
         ("hnswlib_format", {"graph_degree": 32}, [{"ef": e} for e in (32, 64, 128)]),
     ]
+    if ds.metric != "inner_product":
+        # external-library comparator: sklearn spatial trees (L2/cosine
+        # only — it refuses unnormalized MIP)
+        grids.insert(1, ("sklearn", {"algorithm": "ball_tree"}, [{}]))
 
     if args.algos:
         keep = set(args.algos.split(","))

@@ -395,6 +395,46 @@ def from_graph(metric: str, dataset: jax.Array, graph: jax.Array,
 # search (ref: detail/cagra/search_single_cta_kernel-inl.cuh, TPU-batched)
 # --------------------------------------------------------------------------
 
+def make_seed_ids(params: SearchParams, index: Index, queries: jax.Array,
+                  k: int, itopk: Optional[int] = None) -> jax.Array:
+    """Init candidates for a query batch ([q, s] dataset row ids): the
+    coarse entry points (when the index carries them) + a random top-up
+    (the rescue knob for weakly-connected graphs, scaled by
+    num_random_samplings). Factored out of :func:`search` so the sharded
+    search can seed the FULL batch once and split it with the queries —
+    per-query results then don't depend on how the batch was sharded.
+
+    This function OWNS the base itopk formula (``itopk`` overrides it for
+    callers that widen the buffer, e.g. filtered search) — one owner, so
+    the sharded and single-device seed pools cannot drift."""
+    if itopk is None:
+        itopk = min(max(params.itopk_size, k), index.size)
+    n = index.size
+    metric = DISTANCE_TYPES[index.metric]
+    q = queries.shape[0]
+    use_entries = (
+        index.entry_centers is not None and params.num_entry_centers > 0
+    )
+    if use_entries:
+        s = int(min(params.num_entry_centers, index.entry_centers.shape[0]))
+        entry = _entry_seeds(
+            jnp.asarray(queries, jnp.float32),
+            index.entry_centers.astype(jnp.float32),
+            index.entry_ids, s, metric,
+        )
+        n_rand = min(
+            n, max(itopk, 32) * max(1, params.num_random_samplings)
+        )
+    else:
+        entry = None
+        n_rand = min(n, max(2 * itopk, 128) * max(1, params.num_random_samplings))
+    key = jax.random.PRNGKey(params.rand_xor_mask & 0x7FFFFFFF)
+    seed_ids = jax.random.randint(key, (q, n_rand), 0, n, jnp.int32)
+    if entry is not None:
+        seed_ids = jnp.concatenate([entry, seed_ids], axis=1)
+    return seed_ids
+
+
 @functools.partial(jax.jit, static_argnames=("s", "metric"))
 def _entry_seeds(queries, centers, entry_ids, s: int, metric: str):
     """Top-``s`` coarse entry points per query — one MXU matmul + select_k
@@ -580,10 +620,15 @@ def search(
     *,
     sample_filter: Optional[Bitset] = None,
     res: Optional[Resources] = None,
+    seed_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched beam search (ref: cagra_search.cuh → single-CTA kernel,
     re-expressed as query-batched iterations). Returns
-    (distances [q, k], indices [q, k])."""
+    (distances [q, k], indices [q, k]).
+
+    ``seed_ids`` overrides init-candidate generation ([q, s] dataset row
+    ids) — the seam the sharded search uses so per-query results are
+    bit-identical regardless of how the query batch is split."""
     res = ensure(res)
     queries = jnp.asarray(queries, jnp.float32)
     if queries.ndim != 2 or queries.shape[1] != index.dim:
@@ -623,25 +668,10 @@ def search(
     # (one MXU matmul replaces most of the random-restart navigation),
     # topped up with random seeds for graphs/queries the coarse table
     # mis-covers (ref rand_xor_mask seeds + num_random_samplings).
-    if use_entries:
-        s = int(min(params.num_entry_centers, index.entry_centers.shape[0]))
-        entry = _entry_seeds(
-            queries, index.entry_centers.astype(jnp.float32),
-            index.entry_ids, s, metric,
-        )
-        # random top-up still scales with num_random_samplings — the
-        # documented rescue knob for weakly-connected graphs must keep
-        # working when an entry table is present
-        n_rand = min(
-            n, max(itopk, 32) * max(1, params.num_random_samplings)
-        )
+    if seed_ids is None:
+        seed_ids = make_seed_ids(params, index, queries, k, itopk=itopk)
     else:
-        entry = None
-        n_rand = min(n, max(2 * itopk, 128) * max(1, params.num_random_samplings))
-    key = jax.random.PRNGKey(params.rand_xor_mask & 0x7FFFFFFF)
-    seed_ids = jax.random.randint(key, (q, n_rand), 0, n, jnp.int32)
-    if entry is not None:
-        seed_ids = jnp.concatenate([entry, seed_ids], axis=1)
+        seed_ids = jnp.asarray(seed_ids, jnp.int32)
 
     per_q = 4 * (width * deg) * (index.dim + 4) + 16 * itopk
     tile = params.max_queries or max(1, min(max(q, 1), res.workspace_rows(per_q, cap=512)))

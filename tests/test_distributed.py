@@ -243,3 +243,34 @@ def test_sharded_ivf_pq_build_matches_single_device():
         for r in range(64)
     ])
     assert overlap >= 0.98, overlap
+
+
+def test_sharded_cagra_matches_single_device_exactly():
+    """Data-parallel CAGRA (replicated index, sharded queries): results
+    must be bit-identical to the single-device search — the full batch is
+    seeded once and the seeds shard with the queries, so the split cannot
+    change any query's walk."""
+    from raft_tpu.comms.distributed import sharded_cagra_search
+    from raft_tpu.neighbors import cagra
+
+    key = jax.random.PRNGKey(31)
+    x, _, _ = make_blobs(key, 4000, 32, n_clusters=20, cluster_std=2.0)
+    x = np.asarray(x)
+    idx = cagra.build(
+        cagra.IndexParams(
+            intermediate_graph_degree=48, graph_degree=24,
+            build_algo="brute_force",
+        ), x,
+    )
+    comms = Comms(make_mesh(8))
+    q = x[:100] + 0.01  # 100 % 8 != 0 exercises the padding path
+    sp = cagra.SearchParams(
+        itopk_size=32, search_width=1, max_iterations=8,
+        num_entry_centers=16,
+    )
+    v_s, i_s = sharded_cagra_search(comms, idx, q, 10, params=sp)
+    v_1, i_1 = cagra.search(sp, idx, q, 10)
+    np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_1))
+    np.testing.assert_allclose(
+        np.asarray(v_s), np.asarray(v_1), rtol=1e-5, atol=1e-5
+    )
