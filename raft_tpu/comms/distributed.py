@@ -128,6 +128,75 @@ def shard_ivf_pq_index(comms: Comms, index) -> dict:
     }
 
 
+def _sharded_scan_plan(
+    comms: Comms, sharded: dict, queries: jax.Array, k: int,
+    n_probes: int, strategy: str, *, upcast_f32: bool = False,
+):
+    """Shared pre-scan arithmetic for the sharded IVF searches
+    (validation, per-shard probe/k budgets, workspace query tiling,
+    scan-strategy resolution) — ONE owner so the PQ and Flat paths
+    cannot drift. ``upcast_f32`` accounts for scans that gather the
+    stored rows and then copy them to f32 (the flat low-precision path)
+    so low-precision storage doesn't overshoot the workspace budget.
+    Returns (queries as f32, plan dict)."""
+    from raft_tpu.core.resources import ensure as _ensure
+    from raft_tpu.neighbors._common import select_scan_strategy
+
+    size = comms.get_size()
+    L_shard = sharded["centers"].shape[0] // size
+    cap = sharded["list_data"].shape[1]
+    row_dim = sharded["list_data"].shape[2]
+    p_local = min(n_probes, L_shard)
+    k_local = min(k, p_local * cap)
+    if size * k_local < k:
+        raise ValueError(
+            f"k={k} exceeds the global candidate pool "
+            f"{size}*{k_local} (shards*probed slots); raise n_probes"
+        )
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != sharded["centers"].shape[1]:
+        raise ValueError(
+            f"queries shape {queries.shape} vs index dim "
+            f"{sharded['centers'].shape[1]}"
+        )
+    if strategy not in ("auto", "query_major", "probe_major"):
+        raise ValueError(
+            f"strategy must be auto|query_major|probe_major, got {strategy!r}"
+        )
+    ws = _ensure(None).workspace_limit_bytes
+    itemsize = jnp.dtype(sharded["list_data"].dtype).itemsize
+    if upcast_f32 and itemsize < 4:
+        itemsize += 4  # the gathered block plus its f32 copy both live
+    per_q = max(1, p_local * cap * (row_dim * itemsize + 12))
+    query_tile = int(min(queries.shape[0], max(1, ws // per_q)))
+    local_strategy, bucket, bb, q_tile = select_scan_strategy(
+        strategy, queries.shape[0], p_local, L_shard, cap, row_dim, ws,
+        k=k_local,
+    )
+    if local_strategy == "probe_major":
+        # per-step scan work is bounded via bb; the merge buffers via the
+        # probe-major query tile (host-level batching by the caller)
+        query_tile = q_tile
+    return queries, {
+        "L_shard": L_shard, "cap": cap, "row_dim": row_dim,
+        "p_local": p_local, "k_local": k_local,
+        "query_tile": max(1, query_tile),
+        "strategy": local_strategy, "bucket": bucket, "bb": bb,
+    }
+
+
+def _merge_across_shards(v, i, axis: str, k: int, k_local: int):
+    """Pad per-shard top-k_local to k, all-gather, re-select — the
+    knn_merge_parts-equivalent collective tail every sharded IVF search
+    shares. Runs inside shard_map."""
+    if k_local < k:
+        v = jnp.pad(v, ((0, 0), (0, k - k_local)), constant_values=jnp.inf)
+        i = jnp.pad(i, ((0, 0), (0, k - k_local)), constant_values=-1)
+    vg = lax.all_gather(v, axis, axis=1, tiled=True)
+    ig = lax.all_gather(i, axis, axis=1, tiled=True)
+    return select_k(vg, k, select_min=True, input_indices=ig)
+
+
 def sharded_ivf_pq_search(
     comms: Comms,
     sharded: dict,
@@ -157,50 +226,19 @@ def sharded_ivf_pq_search(
     Returns replicated (distances [q, k], ids [q, k]).
     """
     from raft_tpu.distance.pairwise import DISTANCE_TYPES, _PREC
+    from raft_tpu.neighbors._common import run_probe_major
 
     metric = DISTANCE_TYPES[sharded["metric"]]
     mesh, axis = comms.mesh, comms.axis
-    size = comms.get_size()
-    L_shard = sharded["centers"].shape[0] // size
-    cap = sharded["list_data"].shape[1]
-    rot_dim = sharded["list_data"].shape[2]
-    p_local = min(n_probes, L_shard)
-    k_local = min(k, p_local * cap)
-    if size * k_local < k:
-        raise ValueError(
-            f"k={k} exceeds the global candidate pool "
-            f"{size}*{k_local} (shards*probed slots); raise n_probes"
-        )
-    queries = jnp.asarray(queries, jnp.float32)
-    if queries.ndim != 2 or queries.shape[1] != sharded["centers"].shape[1]:
-        raise ValueError(
-            f"queries shape {queries.shape} vs index dim "
-            f"{sharded['centers'].shape[1]}"
-        )
-    # bound the per-shard [tile, p, cap, rot] gather against the workspace
-    # (same sizing rule as the single-device _search_jit query tiling)
-    from raft_tpu.core.resources import ensure as _ensure
-    from raft_tpu.neighbors._common import (
-        run_probe_major,
-        select_scan_strategy,
+    # the PQ scan never upcasts its gather (bf16 scans as bf16, int8 rides
+    # the quantized MXU path) — no upcast allowance in the sizing
+    queries, plan = _sharded_scan_plan(
+        comms, sharded, queries, k, n_probes, strategy
     )
-
-    if strategy not in ("auto", "query_major", "probe_major"):
-        raise ValueError(
-            f"strategy must be auto|query_major|probe_major, got {strategy!r}"
-        )
-    ws = _ensure(None).workspace_limit_bytes
-    itemsize = jnp.dtype(sharded["list_data"].dtype).itemsize
-    per_q = max(1, p_local * cap * (rot_dim * itemsize + 12))
-    query_tile = int(min(queries.shape[0], max(1, ws // per_q)))
-    local_strategy, bucket, bb, q_tile = select_scan_strategy(
-        strategy, queries.shape[0], p_local, L_shard, cap, rot_dim, ws,
-        k=k_local,
-    )
-    if local_strategy == "probe_major":
-        # per-step scan work is bounded via bb; the merge buffers via the
-        # probe-major query tile (host-level batching below)
-        query_tile = q_tile
+    L_shard, cap = plan["L_shard"], plan["cap"]
+    p_local, k_local = plan["p_local"], plan["k_local"]
+    local_strategy, bucket, bb = plan["strategy"], plan["bucket"], plan["bb"]
+    query_tile = plan["query_tile"]
 
     def local(centers_s, valid_s, data_s, y2_s, ids_s, rot, q):
         # coarse over this shard's lists, empty-padding masked out
@@ -283,13 +321,8 @@ def sharded_ivf_pq_search(
             v, i = select_k(
                 flat_s, k_local, select_min=True, input_indices=flat_i
             )
-        if k_local < k:
-            v = jnp.pad(v, ((0, 0), (0, k - k_local)), constant_values=jnp.inf)
-            i = jnp.pad(i, ((0, 0), (0, k - k_local)), constant_values=-1)
         # merge across shards (global ids already)
-        vg = lax.all_gather(v, axis, axis=1, tiled=True)
-        ig = lax.all_gather(i, axis, axis=1, tiled=True)
-        v, i = select_k(vg, k, select_min=True, input_indices=ig)
+        v, i = _merge_across_shards(v, i, axis, k, k_local)
         if metric == "inner_product":
             v = -v
         elif metric == "euclidean":
@@ -407,6 +440,173 @@ def sharded_ivf_pq_build(
         np.asarray(labels)[:n],
         jnp.arange(n, dtype=jnp.int32),
     )
+
+
+def shard_ivf_flat_index(comms: Comms, index) -> dict:
+    """Shard an IVF-Flat index list-wise across the comms axis — the flat
+    sibling of :func:`shard_ivf_pq_index` (raw rows + norms instead of a
+    decoded PQ cache; rows shard in their stored dtype)."""
+    from jax.sharding import NamedSharding
+
+    size = comms.get_size()
+    L = index.n_lists
+    L_pad = -(-L // size) * size
+    pad = L_pad - L
+
+    def dev_put(arr, spec):
+        return jax.device_put(arr, NamedSharding(comms.mesh, spec))
+
+    axis = comms.axis
+    centers = jnp.pad(index.centers, ((0, pad), (0, 0)))
+    data = jnp.pad(index.list_data, ((0, pad), (0, 0), (0, 0)))
+    # padding slots carry +inf norms in the single-device layout; zero
+    # them so inf never enters the MXU product, and mask by id instead
+    norms = jnp.pad(
+        jnp.where(index.list_index >= 0, index.list_norms, 0.0),
+        ((0, pad), (0, 0)),
+    )
+    ids = jnp.pad(index.list_index, ((0, pad), (0, 0)), constant_values=-1)
+    valid = jnp.arange(L_pad) < L
+    return {
+        "centers": dev_put(centers, P(axis, None)),
+        "list_data": dev_put(data, P(axis, None, None)),
+        "list_norms": dev_put(norms, P(axis, None)),
+        "list_index": dev_put(ids, P(axis, None)),
+        "list_valid": dev_put(valid, P(axis)),
+        "metric": index.metric,
+    }
+
+
+def sharded_ivf_flat_search(
+    comms: Comms,
+    sharded: dict,
+    queries: jax.Array,
+    k: int,
+    *,
+    n_probes: int = 20,
+    strategy: str = "auto",
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed IVF-Flat search: per-shard coarse selection over local
+    lists, local scan (query-major or probe-major — the same two
+    schedules as the single-device search), all-gather + re-select merge.
+    Returns replicated (distances [q, k], ids [q, k])."""
+    from raft_tpu.distance.pairwise import _PREC
+    from raft_tpu.neighbors._common import run_probe_major, run_query_tiled
+
+    metric = DISTANCE_TYPES[sharded["metric"]]
+    mesh, axis = comms.mesh, comms.axis
+    # upcast_f32: the flat scan copies the gathered low-precision rows to
+    # f32 before scoring — the sizing must budget gather + copy
+    queries, plan = _sharded_scan_plan(
+        comms, sharded, queries, k, n_probes, strategy, upcast_f32=True
+    )
+    L_shard, cap = plan["L_shard"], plan["cap"]
+    p_local, k_local = plan["p_local"], plan["k_local"]
+    local_strategy, bucket, bb = plan["strategy"], plan["bucket"], plan["bb"]
+    query_tile = plan["query_tile"]
+
+    def local(centers_s, valid_s, data_s, norms_s, ids_s, q):
+        q2 = jnp.sum(q * q, axis=1)
+        qn = jnp.maximum(jnp.sqrt(q2), 1e-12)
+        if metric == "inner_product":
+            coarse = -jnp.matmul(q, centers_s.T, precision=_PREC)
+        elif metric == "cosine":
+            cn = centers_s / jnp.maximum(
+                jnp.linalg.norm(centers_s, axis=1, keepdims=True), 1e-12
+            )
+            coarse = -jnp.matmul(q / qn[:, None], cn.T, precision=_PREC)
+        else:
+            c2 = jnp.sum(centers_s * centers_s, axis=1)
+            coarse = c2[None, :] - 2.0 * jnp.matmul(
+                q, centers_s.T, precision=_PREC
+            )
+        coarse = jnp.where(valid_s[None, :], coarse, jnp.inf)
+        _, probes = select_k(coarse, p_local, select_min=True)
+        n_q = q.shape[0]
+
+        if local_strategy == "probe_major":
+            kk = min(k_local, cap)
+
+            def score_fn(bl, bq):
+                data = data_s[bl]                           # [bb, cap, d]
+                ids_b = ids_s[bl]
+                norms_b = norms_s[bl]
+                qq = q[jnp.clip(bq, 0)]                     # [bb, G, d]
+                ip = lax.dot_general(
+                    qq, data.astype(jnp.float32),
+                    (((2,), (2,)), ((0,), (0,))),
+                    precision=_PREC, preferred_element_type=jnp.float32,
+                )                                           # [bb, G, cap]
+                if metric == "inner_product":
+                    sc = -ip
+                elif metric == "cosine":
+                    vn = jnp.sqrt(jnp.maximum(norms_b, 1e-24))
+                    sc = 1.0 - ip / (
+                        qn[jnp.clip(bq, 0)][:, :, None] * vn[:, None, :]
+                    )
+                else:   # rank-stable L2: +‖q‖² restored after the merge
+                    sc = norms_b[:, None, :] - 2.0 * ip
+                sc = jnp.where(ids_b[:, None, :] < 0, jnp.inf, sc)
+                sc = jnp.where(bq[:, :, None] < 0, jnp.inf, sc)
+                return select_k(
+                    sc.reshape(bb * bucket, cap), kk, select_min=True,
+                    input_indices=jnp.broadcast_to(
+                        ids_b[:, None, :], (bb, bucket, cap)
+                    ).reshape(bb * bucket, cap),
+                )
+
+            v, i = run_probe_major(
+                probes, L_shard, bucket, bb, kk, k_local, score_fn
+            )
+        else:
+            data = data_s[probes]                           # [q, p, cap, d]
+            ids = ids_s[probes]
+            norms = norms_s[probes]
+            ip = lax.dot_general(
+                q, data.astype(jnp.float32),
+                (((1,), (3,)), ((0,), (0,))),
+                precision=_PREC, preferred_element_type=jnp.float32,
+            )                                               # [q, p, cap]
+            if metric == "inner_product":
+                sc = -ip
+            elif metric == "cosine":
+                vn = jnp.sqrt(jnp.maximum(norms, 1e-24))
+                sc = 1.0 - ip / (qn[:, None, None] * vn)
+            else:
+                sc = norms - 2.0 * ip
+            sc = jnp.where(ids < 0, jnp.inf, sc)
+            v, i = select_k(
+                sc.reshape(n_q, p_local * cap), k_local, select_min=True,
+                input_indices=ids.reshape(n_q, p_local * cap),
+            )
+        v, i = _merge_across_shards(v, i, axis, k, k_local)
+        # postprocess (rank-stable parts restored; matches ivf_flat.search)
+        if metric == "inner_product":
+            v = -v
+        elif metric == "euclidean":
+            v = jnp.sqrt(jnp.maximum(v + q2[:, None], 0.0))
+        elif metric == "sqeuclidean":
+            v = v + q2[:, None]
+        return v, i
+
+    f = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None), P(axis), P(axis, None, None), P(axis, None),
+            P(axis, None), P(None, None),
+        ),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+
+    def run_tile(qq):
+        return f(
+            sharded["centers"], sharded["list_valid"], sharded["list_data"],
+            sharded["list_norms"], sharded["list_index"], qq,
+        )
+
+    return run_query_tiled(run_tile, queries, max(1, query_tile))
 
 
 def sharded_cagra_search(

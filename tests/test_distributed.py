@@ -274,3 +274,52 @@ def test_sharded_cagra_matches_single_device_exactly():
     np.testing.assert_allclose(
         np.asarray(v_s), np.asarray(v_1), rtol=1e-5, atol=1e-5
     )
+
+
+def test_sharded_ivf_flat_matches_single_device():
+    """Sharded IVF-Flat (flat sibling of the sharded PQ search): probe-all
+    faithfulness vs single-device, strategy agreement, and the cosine
+    metric leg."""
+    from raft_tpu.comms.distributed import (
+        shard_ivf_flat_index,
+        sharded_ivf_flat_search,
+    )
+    from raft_tpu.neighbors import ivf_flat
+
+    key = jax.random.PRNGKey(12)
+    x, _, _ = make_blobs(key, 4096, 32, n_clusters=32, cluster_std=2.0)
+    x = np.asarray(x)
+    q = x[:64] + 0.001
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=5), x)
+    comms = Comms(make_mesh(8))
+    sh = shard_ivf_flat_index(comms, idx)
+    d_s, i_s = sharded_ivf_flat_search(comms, sh, q, 32, n_probes=32)
+    d_1, i_1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), idx, q, 32)
+    ov = np.mean([
+        len(np.intersect1d(np.asarray(i_s)[r], np.asarray(i_1)[r])) / 32
+        for r in range(64)
+    ])
+    assert ov >= 0.98, ov
+    np.testing.assert_allclose(
+        np.sort(np.asarray(d_s), 1), np.sort(np.asarray(d_1), 1),
+        rtol=1e-3, atol=1e-3,
+    )
+    # the two local scan schedules agree
+    q300 = x[:300] + 0.001
+    _, i_q = sharded_ivf_flat_search(
+        comms, sh, q300, 10, n_probes=4, strategy="query_major")
+    _, i_p = sharded_ivf_flat_search(
+        comms, sh, q300, 10, n_probes=4, strategy="probe_major")
+    assert (np.asarray(i_q) == np.asarray(i_p)).mean() >= 0.99
+    # cosine leg
+    idx_c = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=5, metric="cosine"), x)
+    sh_c = shard_ivf_flat_index(comms, idx_c)
+    _, i_cs = sharded_ivf_flat_search(comms, sh_c, q, 10, n_probes=32)
+    _, i_c1 = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32), idx_c, q, 10)
+    ovc = np.mean([
+        len(np.intersect1d(np.asarray(i_cs)[r], np.asarray(i_c1)[r])) / 10
+        for r in range(64)
+    ])
+    assert ovc >= 0.98, ovc
