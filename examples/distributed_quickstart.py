@@ -47,10 +47,12 @@ def main() -> None:
     from raft_tpu.comms.distributed import (
         kmeans_fit,
         shard_ivf_pq_index,
+        sharded_cagra_search,
+        sharded_ivf_pq_build,
         sharded_ivf_pq_search,
         sharded_knn,
     )
-    from raft_tpu.neighbors import brute_force, ivf_pq, refine
+    from raft_tpu.neighbors import brute_force, cagra, ivf_pq, refine
     from raft_tpu.stats import neighborhood_recall
 
     n_dev = len(jax.devices())
@@ -79,15 +81,29 @@ def main() -> None:
     r = float(neighborhood_recall(np.asarray(ids), np.asarray(gt)))
     print(f"sharded_knn: recall vs single-device exact = {r:.4f}")
 
-    # 3. distributed ANN: list-sharded IVF-PQ + refine
-    index = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=64, pq_dim=args.dim // 2, kmeans_n_iters=5), x
+    # 3. distributed ANN, build AND search: MNMG IVF-PQ build (shard-local
+    # encode against the replicated quantizer — byte-identical to a
+    # single-device build) → list-sharded search + refine
+    index = sharded_ivf_pq_build(
+        comms, xs,
+        ivf_pq.IndexParams(n_lists=64, pq_dim=args.dim // 2, kmeans_n_iters=5),
     )
     sharded = shard_ivf_pq_index(comms, index)
     _, ci = sharded_ivf_pq_search(comms, sharded, jnp.asarray(q), 40, n_probes=16)
     _, ids2 = refine(x, q, ci, 10)
     r2 = float(neighborhood_recall(np.asarray(ids2), np.asarray(gt)))
-    print(f"sharded_ivf_pq_search + refine: recall = {r2:.4f}")
+    print(f"sharded_ivf_pq_build → sharded search + refine: recall = {r2:.4f}")
+
+    # 4. data-parallel CAGRA: replicated graph index, sharded query stream
+    g = cagra.build(
+        cagra.IndexParams(graph_degree=32, intermediate_graph_degree=48), x
+    )
+    _, ids3 = sharded_cagra_search(
+        comms, g, q, 10,
+        params=cagra.SearchParams(itopk_size=16, max_iterations=6),
+    )
+    r3 = float(neighborhood_recall(np.asarray(ids3), np.asarray(gt)))
+    print(f"sharded_cagra_search: recall = {r3:.4f}")
     print("ok")
 
 
