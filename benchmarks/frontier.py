@@ -87,6 +87,16 @@ def main() -> None:
             + [{"itopk_size": 64, "search_width": 1},
                {"itopk_size": 64, "search_width": 4}],
         ),
+        (
+            # memory-lean CAGRA: VPQ-compressed dataset, decode-on-gather
+            "raft_tpu_cagra_vpq",
+            {"graph_degree": 64, "intermediate_graph_degree": 128},
+            [
+                {"itopk_size": t, "search_width": 1, "max_iterations": mi,
+                 "num_entry_centers": 16}
+                for t in (16, 32) for mi in (4, 8)
+            ],
+        ),
         ("hnswlib_format", {"graph_degree": 32}, [{"ef": e} for e in (32, 64, 128)]),
     ]
     if ds.metric != "inner_product":

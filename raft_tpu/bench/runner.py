@@ -135,8 +135,15 @@ class CagraANN(ANN):
         from raft_tpu.neighbors import cagra
 
         self._mod = cagra
-        params = cagra.IndexParams(metric=self.metric, **self.build_param)
+        bp = dict(self.build_param)
+        # "compress": True benches the VPQ-compressed dataset variant
+        # (decode-on-gather — the memory-lean CAGRA, ref cagra
+        # index_params.compression)
+        compress = bp.pop("compress", False)
+        params = cagra.IndexParams(metric=self.metric, **bp)
         self._index = cagra.build(params, jnp.asarray(dataset))
+        if compress:
+            self._index = cagra.compress(self._index)
         self._sp = cagra.SearchParams()
 
     def set_search_param(self, param):
@@ -149,6 +156,18 @@ class CagraANN(ANN):
 
     def save(self, path):
         self._mod.save(path, self._index)
+
+
+class CagraVpqANN(CagraANN):
+    """CAGRA over a VPQ-compressed dataset (decode-on-gather) — the
+    memory-lean variant benched as its own algorithm so frontier
+    artifacts separate its pareto curve from dense CAGRA."""
+
+    name = "raft_tpu_cagra_vpq"
+
+    def build(self, dataset):
+        self.build_param = {**self.build_param, "compress": True}
+        super().build(dataset)
 
 
 class BallCoverANN(ANN):
@@ -349,8 +368,8 @@ class HnswANN(ANN):
 ALGORITHMS = {
     a.name: a
     for a in (
-        BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, BallCoverANN,
-        NumpyExactANN, SklearnANN, HnswANN,
+        BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, CagraVpqANN,
+        BallCoverANN, NumpyExactANN, SklearnANN, HnswANN,
     )
 }
 

@@ -348,3 +348,22 @@ def test_hdf5_roundtrip_when_h5py_present(tmp_path, rng):
     np.testing.assert_array_equal(ds2.base, base)
     np.testing.assert_array_equal(ds2.queries, qs)
     assert ds2.gt_neighbors.shape == (20, 5)
+
+
+def test_cagra_vpq_comparator(ds):
+    """VPQ-compressed CAGRA benches as its own algorithm: compressed
+    dataset (decode-on-gather) with a competitive recall."""
+    rs = runner.run_case(
+        ds, "raft_tpu_cagra_vpq",
+        {"graph_degree": 16, "intermediate_graph_degree": 24},
+        [{"itopk_size": 32, "num_entry_centers": 8}], k=10,
+        warmup=0, iters=1,
+    )
+    assert rs[0].recall >= 0.7, rs[0].recall
+    from raft_tpu.neighbors.vpq_dataset import VpqDataset
+
+    # it really searched the compressed dataset
+    algo = runner.ALGORITHMS["raft_tpu_cagra_vpq"]
+    a = algo(ds.metric, {"graph_degree": 16, "intermediate_graph_degree": 24})
+    a.build(ds.base)
+    assert isinstance(a._index.dataset, VpqDataset)
