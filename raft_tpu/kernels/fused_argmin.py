@@ -33,10 +33,13 @@ def _fused_argmin_kernel(x_ref, c_ref, cc_ref, val_ref, idx_ref, *, tile_c: int)
         idx_ref[:] = jnp.zeros_like(idx_ref)
 
     nt = x_ref.shape[0]
+    # HIGHEST: match the XLA distance paths (pairwise._PREC) — default
+    # MXU precision flips argmins on near-tie centers
     dots = jax.lax.dot_general(
         x_ref[:], c_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     scores = cc_ref[0, :][None, :] - 2.0 * dots       # [nt, tile_c]
 

@@ -49,10 +49,13 @@ def _fused_knn_kernel(q_ref, x_ref, xx_ref, vals_ref, idx_ref, *, k: int,
 
     qt = q_ref.shape[0]
     # MXU: [qt, d] @ [d, tile_n] — scores are partial L2 (or negated IP)
+    # HIGHEST: match the XLA distance paths (pairwise._PREC) — the MXU's
+    # default bf16-accumulate shuffles near-tie neighbor ranks
     dots = jax.lax.dot_general(
         q_ref[:], x_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     scores = xx_ref[0, :][None, :] - 2.0 * dots  # xx = +inf on padded rows
 

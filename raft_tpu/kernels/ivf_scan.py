@@ -72,7 +72,7 @@ def pack_list_filter(list_index: jax.Array, filter_words: jax.Array):
 
 def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
                  q2_ref, scale_ref, vals_ref, out_ids_ref, *, kk: int,
-                 metric: str, filtered: bool):
+                 metric: str, filtered: bool, scan_dtype: str):
     """One bucket: score its list's rows against its G queries, keep the
     per-query top-kk.  dec/y2/ids/filt blocks were selected by the
     prefetched bucket_list (dynamic index_map); qg/q2 are the bucket's
@@ -96,28 +96,48 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
         ip = ip_i32.astype(jnp.float32) * (sq * scale_ref[0, 0])
     else:
         # MXU: [G, rot] × [cap, rot]ᵀ; the stored rows upcast in VMEM (one
-        # [cap, rot] tile), never as a full-index HBM copy
+        # [cap, rot] tile), never as a full-index HBM copy.  scan_dtype
+        # mirrors the caller's XLA schedule so the two legs rank ties the
+        # same way: "highest" = f32 + HIGHEST (ivf_flat / pairwise._PREC),
+        # "float32"/"bfloat16" = the ivf_pq lut_dtype ladder at MXU
+        # default precision
+        sd = jnp.bfloat16 if scan_dtype == "bfloat16" else jnp.float32
         ip = jax.lax.dot_general(
-            qg_ref[0], dec_ref[0].astype(jnp.float32),
+            qg_ref[0].astype(sd), dec_ref[0].astype(sd),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=(
+                jax.lax.Precision.HIGHEST if scan_dtype == "highest"
+                else jax.lax.Precision.DEFAULT
+            ),
         )                                                # [G, cap]
-    q2 = q2_ref[0, :]                                    # [G]
+    # Mosaic lowering: every vector op stays 2-D — q2 rides as a [G, 1]
+    # column block and y2/ids as [1, cap] rows, so the masks build from
+    # plain 2-D broadcasts (1-D reshapes/transposes crash tpu_compile)
+    q2 = q2_ref[0]                                       # [G, 1]
     if metric == "inner_product":
         scores = -ip
     else:
-        scores = y2_ref[0, :][None, :] - 2.0 * ip + q2[:, None]
-    ids_row = ids_ref[0, :]                              # [cap]
-    invalid = (ids_row < 0)[None, :] | jnp.isinf(q2)[:, None]
+        scores = y2_ref[0] - 2.0 * ip + q2               # [G, cap]
+    ids_row = ids_ref[0]                                 # [1, cap]
+    invalid = (ids_row < 0) | jnp.isinf(q2)              # [G, cap]
     if filtered:
-        words = filt_ref[0, :]                           # [cap_w] uint32
-        cap_w = words.shape[0]
-        shifts = jax.lax.broadcasted_iota(jnp.uint32, (cap_w, 32), 1)
-        bits = (words[:, None] >> shifts) & 1            # [cap_w, 32]
-        passing = bits.reshape(cap_w * 32)[:cap] == 1    # [cap]
-        invalid = invalid | ~passing[None, :]
+        words = filt_ref[0]                              # [1, cap_w] uint32
+        cap_w = words.shape[1]
+        # lane-oriented expansion: repeat each word across its 32 lanes
+        # (broadcast + minormost reshape — the only reshape shape Mosaic
+        # lowers cheaply), then shift by lane position % 32
+        rep = jnp.broadcast_to(
+            words[:, :, None], (1, cap_w, 32)
+        ).reshape(1, cap_w * 32)
+        shifts = (
+            jax.lax.broadcasted_iota(jnp.uint32, (1, cap_w * 32), 1)
+            % jnp.uint32(32)
+        )
+        passing = ((rep >> shifts) & 1)[:, :cap] == 1    # [1, cap]
+        invalid = invalid | ~passing
     scores = jnp.where(invalid, _WORST, scores)
-    cand_i = jnp.broadcast_to(ids_row[None, :], (G, cap))
+    cand_i = jnp.broadcast_to(ids_row, (G, cap))
     run_v = jnp.full((G, kk), _WORST, jnp.float32)
     run_i = jnp.full((G, kk), -1, jnp.int32)
     v, i = fold_topk(run_v, run_i, scores, cand_i, kk)
@@ -127,7 +147,7 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("kk", "metric", "interpret")
+    jax.jit, static_argnames=("kk", "metric", "scan_dtype", "interpret")
 )
 def ivf_scan_probe_major(
     bucket_list: jax.Array,   # [B] int32 — list id per bucket
@@ -139,6 +159,7 @@ def ivf_scan_probe_major(
     kk: int,
     *,
     metric: str = "sqeuclidean",
+    scan_dtype: str = "highest",  # highest | float32 | bfloat16 (float leg)
     list_filter: jax.Array | None = None,  # [L, ceil(cap/32)] uint32
     scan_scale: float = 1.0,  # int8 cache dequant scale (1.0 for floats)
     interpret: bool = False,
@@ -157,6 +178,12 @@ def ivf_scan_probe_major(
         list_filter = jnp.zeros((L, 1), jnp.uint32)
     cap_w = list_filter.shape[1]
 
+    # 2-D operands indexed by the dynamic list id carry a singleton middle
+    # axis: Mosaic requires each block's last two dims to be (8, 128)-
+    # divisible OR equal to the array dims, and a (1, cap) block over an
+    # [L, cap] array satisfies neither when L is dynamic-selected.  As
+    # [L, 1, cap] the block (1, 1, cap) matches the trailing (1, cap)
+    # exactly (first real Mosaic compile, round 4).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B,),
@@ -164,11 +191,11 @@ def ivf_scan_probe_major(
             pl.BlockSpec(       # dec: the bucket's list rows (dynamic)
                 (1, cap, rot), lambda b, bl: (bl[b], 0, 0)
             ),
-            pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # y2
-            pl.BlockSpec((1, cap), lambda b, bl: (bl[b], 0)),   # ids
-            pl.BlockSpec((1, cap_w), lambda b, bl: (bl[b], 0)),  # filter
+            pl.BlockSpec((1, 1, cap), lambda b, bl: (bl[b], 0, 0)),   # y2
+            pl.BlockSpec((1, 1, cap), lambda b, bl: (bl[b], 0, 0)),   # ids
+            pl.BlockSpec((1, 1, cap_w), lambda b, bl: (bl[b], 0, 0)),  # filt
             pl.BlockSpec((1, G, rot), lambda b, bl: (b, 0, 0)),  # queries
-            pl.BlockSpec((1, G), lambda b, bl: (b, 0)),          # q2
+            pl.BlockSpec((1, G, 1), lambda b, bl: (b, 0, 0)),    # q2 column
             pl.BlockSpec(memory_space=pltpu.SMEM),               # scan_scale
         ],
         out_specs=[
@@ -178,7 +205,8 @@ def ivf_scan_probe_major(
     )
     vals, ids = pl.pallas_call(
         functools.partial(
-            _scan_kernel, kk=kk, metric=metric, filtered=filtered
+            _scan_kernel, kk=kk, metric=metric, filtered=filtered,
+            scan_dtype=scan_dtype,
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -189,11 +217,11 @@ def ivf_scan_probe_major(
     )(
         bucket_list,
         list_data,
-        list_y2,
-        list_index,
-        list_filter,
+        list_y2[:, None, :],
+        list_index[:, None, :],
+        list_filter[:, None, :],
         q_gathered,
-        q2_gathered,
+        q2_gathered[:, :, None],
         jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),
     )
     return vals, ids

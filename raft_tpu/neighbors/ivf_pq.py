@@ -1150,12 +1150,14 @@ def _search_probe_major_jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_probes", "k", "metric", "bucket", "interpret"),
+    static_argnames=(
+        "n_probes", "k", "metric", "bucket", "scan_dtype", "interpret"
+    ),
 )
 def _search_probe_major_pallas(
     queries, centers, rotation, list_data, list_y2, list_index,
     list_filter, scan_scale, n_probes: int, k: int, metric: str,
-    bucket: int, interpret: bool,
+    bucket: int, scan_dtype: str, interpret: bool,
 ):
     """Probe-major schedule with the fused Pallas scan
     (kernels/ivf_scan.py): per-bucket list rows DMA into VMEM via the
@@ -1184,8 +1186,8 @@ def _search_probe_major_pallas(
     q2g = jnp.where(bucket_query >= 0, q2[jnp.clip(bucket_query, 0)], jnp.inf)
     vals, ids = ivf_scan_probe_major(
         bucket_list, qg, q2g, list_data, list_y2, list_index, kk,
-        metric=metric, list_filter=list_filter, scan_scale=scan_scale,
-        interpret=interpret,
+        metric=metric, scan_dtype=scan_dtype, list_filter=list_filter,
+        scan_scale=scan_scale, interpret=interpret,
     )
     v, i = _merge(
         vals.reshape(B * G, kk), ids.reshape(B * G, kk),
@@ -1239,7 +1241,10 @@ def search(
     if strategy == "probe_major":
         if pallas_scan_enabled(
             canonical, index.list_data.dtype, allow_int8=True
-        ):
+        ) and params.internal_distance_dtype == "float32":
+            # the kernel accumulates f32 only; a bf16 internal-distance
+            # request must keep the XLA leg (preferred_element_type=
+            # acc_dtype) or the two legs rank near-ties differently
             from raft_tpu.kernels import interpret_mode
             from raft_tpu.kernels.ivf_scan import pack_list_filter
 
@@ -1254,7 +1259,7 @@ def search(
                     qt, index.centers, index.rotation, index.list_data,
                     index.list_y2, index.list_index, lf,
                     float(index.scan_scale), n_probes, int(k),
-                    canonical, bucket, interpret_mode(),
+                    canonical, bucket, params.lut_dtype, interpret_mode(),
                 )
         else:
             def run_pm(qt):

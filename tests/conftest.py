@@ -10,13 +10,17 @@ platform selected, so env vars are too late — we switch platforms through
 jax.config, which works because no backend has been initialized yet.
 """
 
+import os
+
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# RAFT_TPU_TEST_DEVICE=1 leaves the real accelerator visible so the
+# on-chip gated tests (TestPallasCompilesOnTpu etc.) actually run;
+# default is the 8-virtual-device CPU mesh described above.
+if not os.environ.get("RAFT_TPU_TEST_DEVICE"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", False)
-
-import os  # noqa: E402
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -27,6 +31,19 @@ def pytest_collection_modifyitems(config, items):
     locally via RAFT_TPU_RUN_SLOW=1, or in the TPU bench environment
     (mirrors the reference's split between unit suites and the large
     ann-bench datasets)."""
+    if len(jax.devices()) != 8:
+        # RAFT_TPU_TEST_DEVICE runs (real accelerator, usually 1 chip):
+        # mesh/collective suites hard-require the 8-way virtual mesh —
+        # skip them instead of tripping their device-count asserts
+        mesh_skip = pytest.mark.skip(
+            reason="needs the 8-virtual-device CPU mesh (unset "
+            "RAFT_TPU_TEST_DEVICE)"
+        )
+        for item in items:
+            if item.fspath and item.fspath.basename in (
+                "test_comms.py", "test_distributed.py"
+            ):
+                item.add_marker(mesh_skip)
     if os.environ.get("RAFT_TPU_RUN_SLOW"):
         return
     skip = pytest.mark.skip(reason="slow scale test; set RAFT_TPU_RUN_SLOW=1")
