@@ -30,7 +30,9 @@ EOF
 
 commit_artifact() {  # commit_artifact <file> <message>
   [ -s "$1" ] || return 1
-  git add "$1" && git commit -q -m "$2" && echo "committed: $2" >>"$LOG"
+  # pathspec'd commit: never sweep unrelated staged session edits into an
+  # artifact commit
+  git add "$1" && git commit -q -m "$2" -- "$1" && echo "committed: $2" >>"$LOG"
 }
 
 run_item() {  # run_item <artifact> <timeout_s> <message> <cmd...>
@@ -42,7 +44,8 @@ run_item() {  # run_item <artifact> <timeout_s> <message> <cmd...>
   if [ $rc -eq 0 ] && [ -s "$art" ]; then
     commit_artifact "$art" "$msg"
   else
-    echo "item rc=$rc (artifact $([ -s "$art" ] && echo present || echo MISSING))" >>"$LOG"
+    echo "item rc=$rc; removing partial artifact so it retries" >>"$LOG"
+    rm -f "$art"            # a truncated file must not read as "proven"
     return 1
   fi
 }

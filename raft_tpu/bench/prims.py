@@ -73,24 +73,31 @@ def _cases() -> List[Dict]:
                 "flops": 0,
             }
         )
-    for rows, cols, k in [
-        (1024, 16384, 64), (128, 131072, 256), (64, 1_000_000, 100),
-        (4096, 8192, 16),
-    ]:
+    # decision-boundary sweep for the auto heuristic: cols crosses the
+    # current _CHUNKED_MIN_N=8192 from both sides at the k values the
+    # dispatch branches on (fit with benchmarks/fit_heuristics.py).
+    # ONE device array per (rows, cols), shared across the k/algo grid —
+    # per-k copies would hold ~3x the HBM for the whole run
+    ab_shapes = {(1024, c): (10, 64, 256) for c in
+                 (4096, 8192, 16384, 32768, 131072)}
+    ab_shapes[(64, 1_000_000)] = (100,)
+    ab_shapes[(4096, 8192)] = (16,)
+    for (rows, cols), ks in ab_shapes.items():
         x = jnp.asarray(rng.standard_normal((rows, cols)).astype(np.float32))
-        for algo in ("topk", "chunked"):
-            fn = jax.jit(
-                functools.partial(select_k, k=k, select_min=True, algo=algo)
-            )
-            cases.append(
-                {
-                    "name": f"select_k_ab/{rows}x{cols}/k{k}/{algo}",
-                    "fn": fn,
-                    "args": (x,),
-                    "bytes": rows * cols * 4,
-                    "flops": 0,
-                }
-            )
+        for k in ks:
+            for algo in ("topk", "chunked"):
+                fn = jax.jit(
+                    functools.partial(select_k, k=k, select_min=True, algo=algo)
+                )
+                cases.append(
+                    {
+                        "name": f"select_k_ab/{rows}x{cols}/k{k}/{algo}",
+                        "fn": fn,
+                        "args": (x,),
+                        "bytes": rows * cols * 4,
+                        "flops": 0,
+                    }
+                )
 
     # pairwise distance (ref: bench/prims/distance/)
     for m, n, d, metric in [(2048, 2048, 128, "sqeuclidean"), (1024, 1024, 512, "l1")]:
@@ -133,15 +140,32 @@ def _cases() -> List[Dict]:
     # probe-major case reads far less physically; gbps here is a
     # schedule-comparable "effective" rate, not measured HBM bandwidth
     scan_bytes = 4096 * 32 * (100_000 // 1024) * 96 * 2
-    for strat in ("query_major", "probe_major"):
+    for strat, pallas in (
+        ("query_major", False), ("probe_major", False), ("probe_major", True)
+    ):
         sp = _pq.SearchParams(n_probes=32, strategy=strat)
 
-        def scan_fn(q, _sp=sp):
-            return _pq.search(_sp, _scan_index(), q, 10)
+        def scan_fn(q, _sp=sp, _pallas=pallas):
+            # the Pallas gate is read per search call, so the A/B leg can
+            # flip it around the dispatch (promotion evidence: VERDICT r3
+            # item 10 — default-on requires this case to win on chip)
+            prev = os.environ.get("RAFT_TPU_PALLAS")
+            if _pallas:
+                os.environ["RAFT_TPU_PALLAS"] = "1"
+            else:
+                os.environ.pop("RAFT_TPU_PALLAS", None)
+            try:
+                return _pq.search(_sp, _scan_index(), q, 10)
+            finally:
+                if prev is None:
+                    os.environ.pop("RAFT_TPU_PALLAS", None)
+                else:
+                    os.environ["RAFT_TPU_PALLAS"] = prev
 
         cases.append(
             {
-                "name": f"ivf_scan_ab/100kx96/p32/{strat}",
+                "name": f"ivf_scan_ab/100kx96/p32/{strat}"
+                + ("_pallas" if pallas else ""),
                 "fn": scan_fn,
                 "args": (qs,),
                 "bytes": scan_bytes,

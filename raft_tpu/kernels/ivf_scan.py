@@ -19,7 +19,9 @@ Used by the ivf_pq AND ivf_flat probe-major paths when
 ``RAFT_TPU_PALLAS=1`` (same gate as the fused kNN kernel).  Coverage
 (round 4 widened to match the reference's compute_similarity surface):
 
-- **Metrics**: L2 (sqeuclidean/euclidean) and **inner product**.
+- **Metrics**: L2 (sqeuclidean/euclidean), **inner product**, and
+  **cosine** (ivf_flat's normalized leg, same rsqrt floors as its XLA
+  schedule).
 - **Storage**: f32/bf16 rows upcast in VMEM; ivf_pq's **int8 scan cache
   takes the fused quantized-query leg** (per-query symmetric
   quantization, int8×int8 MXU dot, scan_scale rescale — the memory-lean
@@ -117,6 +119,12 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
     q2 = q2_ref[0]                                       # [G, 1]
     if metric == "inner_product":
         scores = -ip
+    elif metric == "cosine":
+        # same guards as the XLA leg (ivf_flat score_fn): rsqrt with the
+        # floors keeps padding (+inf q2 → rsqrt→0) and zero rows finite
+        qn_inv = jax.lax.rsqrt(jnp.maximum(q2, 1e-24))   # [G, 1]
+        vn_inv = jax.lax.rsqrt(jnp.maximum(y2_ref[0], 1e-24))  # [1, cap]
+        scores = 1.0 - ip * qn_inv * vn_inv
     else:
         scores = y2_ref[0] - 2.0 * ip + q2               # [G, cap]
     ids_row = ids_ref[0]                                 # [1, cap]

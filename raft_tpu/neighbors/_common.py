@@ -347,7 +347,7 @@ def pallas_scan_enabled(
     metric: str, storage_dtype, *, allow_int8: bool = False
 ) -> bool:
     """ONE copy of the fused-Pallas-scan gate shared by ivf_pq and
-    ivf_flat: opt-in via RAFT_TPU_PALLAS=1, L2 + inner-product metrics,
+    ivf_flat: opt-in via RAFT_TPU_PALLAS=1, L2 + inner-product + cosine,
     float/bf16 storage (the kernel upcasts in VMEM). Filtered searches
     ride the kernel's packed per-list word table (round 4 — see
     kernels/ivf_scan.pack_list_filter). ``allow_int8`` admits the
@@ -358,7 +358,7 @@ def pallas_scan_enabled(
     dtypes = (jnp.float32, jnp.bfloat16) + ((jnp.int8,) if allow_int8 else ())
     return (
         os.environ.get("RAFT_TPU_PALLAS") == "1"
-        and metric in ("sqeuclidean", "euclidean", "inner_product")
+        and metric in ("sqeuclidean", "euclidean", "inner_product", "cosine")
         and storage_dtype in dtypes
     )
 

@@ -357,9 +357,31 @@ class TestIvfScanKernel:
             np.asarray(v_xi), np.asarray(v_pi), rtol=2e-3, atol=1e-3
         )
 
-    def test_ivf_flat_gate_excludes_cosine_and_raw_int8(self, monkeypatch):
-        """Remaining exclusions: cosine and raw int8 datasets (no dequant
-        scale) must still route to the XLA schedule."""
+    def test_ivf_flat_cosine_matches_xla(self, monkeypatch):
+        """Round 4 widening: cosine rides the kernel's normalized leg and
+        must agree with the XLA schedule (same rsqrt floors)."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(3)
+        x, _, _ = make_blobs(key, 4000, 16, n_clusters=16, cluster_std=2.0)
+        x = np.asarray(x)
+        q = jnp.asarray(x[:300])
+        sp = ivf_flat.SearchParams(n_probes=8, strategy="probe_major")
+        idx_cos = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3, metric="cosine"), x
+        )
+        v_x, i_x = ivf_flat.search(sp, idx_cos, q, 5)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_flat.search(sp, idx_cos, q, 5)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+
+    def test_ivf_flat_gate_excludes_raw_int8(self, monkeypatch):
+        """Raw int8 datasets (no dequant scale) must still route to the
+        XLA schedule."""
         from raft_tpu.neighbors import ivf_flat
         from raft_tpu.random import make_blobs
 
@@ -374,10 +396,6 @@ class TestIvfScanKernel:
 
         monkeypatch.setattr(ivf_flat, "_search_probe_major_pallas", boom)
         sp = ivf_flat.SearchParams(n_probes=8, strategy="probe_major")
-        idx_cos = ivf_flat.build(
-            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3, metric="cosine"), x
-        )
-        ivf_flat.search(sp, idx_cos, q, 5)
         x8 = (x * 10).astype(np.int8)
         idx_i8 = ivf_flat.build(
             ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3), x8
