@@ -88,6 +88,17 @@ def main() -> None:
                {"itopk_size": 64, "search_width": 4}],
         ),
         (
+            # half-the-gather-bytes CAGRA: bf16 traversal dataset (the
+            # beam search is gather-bandwidth-bound; see runner.CagraANN)
+            "raft_tpu_cagra_bf16",
+            {"graph_degree": 64, "intermediate_graph_degree": 128},
+            [
+                {"itopk_size": t, "search_width": 1, "max_iterations": mi,
+                 "num_entry_centers": 16}
+                for t in (16, 32) for mi in (4, 6, 8)
+            ],
+        ),
+        (
             # memory-lean CAGRA: VPQ-compressed dataset, decode-on-gather
             "raft_tpu_cagra_vpq",
             {"graph_degree": 64, "intermediate_graph_degree": 128},

@@ -140,8 +140,15 @@ class CagraANN(ANN):
         # (decode-on-gather — the memory-lean CAGRA, ref cagra
         # index_params.compression)
         compress = bp.pop("compress", False)
+        # "dataset_dtype": "bfloat16" stores the traversal dataset in bf16
+        # — halves the hot loop's gather bytes (the reference's half-
+        # precision dataset template, cagra_types.hpp:142)
+        ds_dtype = bp.pop("dataset_dtype", None)
         params = cagra.IndexParams(metric=self.metric, **bp)
-        self._index = cagra.build(params, jnp.asarray(dataset))
+        ds = jnp.asarray(dataset)
+        if ds_dtype:
+            ds = ds.astype(ds_dtype)
+        self._index = cagra.build(params, ds)
         if compress:
             self._index = cagra.compress(self._index)
         self._sp = cagra.SearchParams()
@@ -167,6 +174,18 @@ class CagraVpqANN(CagraANN):
 
     def build(self, dataset):
         self.build_param = {**self.build_param, "compress": True}
+        super().build(dataset)
+
+
+class CagraBf16ANN(CagraANN):
+    """CAGRA over a bf16 traversal dataset — half the gather bytes in the
+    bandwidth-bound beam search (the reference's half-precision dataset
+    template, cagra_types.hpp:142)."""
+
+    name = "raft_tpu_cagra_bf16"
+
+    def build(self, dataset):
+        self.build_param = {**self.build_param, "dataset_dtype": "bfloat16"}
         super().build(dataset)
 
 
@@ -369,7 +388,7 @@ ALGORITHMS = {
     a.name: a
     for a in (
         BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, CagraVpqANN,
-        BallCoverANN, NumpyExactANN, SklearnANN, HnswANN,
+        CagraBf16ANN, BallCoverANN, NumpyExactANN, SklearnANN, HnswANN,
     )
 }
 

@@ -373,6 +373,14 @@ class TestIvfScanKernel:
         )
         v_x, i_x = ivf_flat.search(sp, idx_cos, q, 5)
         monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        # prove the kernel path actually dispatches (a gate regression
+        # would otherwise make this equivalence vacuous)
+        monkeypatch.setattr(
+            ivf_flat, "_search_probe_major_jit",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("XLA path taken despite RAFT_TPU_PALLAS=1")
+            ),
+        )
         v_p, i_p = ivf_flat.search(sp, idx_cos, q, 5)
         assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
         np.testing.assert_allclose(
