@@ -663,6 +663,123 @@ def sharded_cagra_search(
     return v[:q], i[:q]
 
 
+def sharded_cagra_build(
+    comms: Comms,
+    params,
+    dataset,
+    *,
+    max_cluster_rows: int = 65_536,
+    res=None,
+):
+    """MNMG CAGRA build — closes the one index build that was still
+    single-device-only. The batch-GNND plan (balanced clustering + top-2
+    overlap assignment, nn_descent.plan_batches — the raft-dask MNMG
+    pattern of planning once and fanning the O(n) work out) runs
+    host-side; the expensive per-batch graph builds run data-parallel
+    over the mesh (batches stack [B, pad_m, d] and shard over the comms
+    axis; each device ``lax.map``s a fixed-iteration GNND over its local
+    batches); local graphs merge host-side exactly as in
+    ``nn_descent.build_batch``; optimize + entry-point construction run
+    replicated on the merged graph.
+
+    **Split-invariant by design**: each batch's PRNG key folds in its
+    GLOBAL batch index, and the GNND runs a fixed iteration count (an
+    SPMD worker set cannot take data-dependent early exits divergently)
+    — so the built index is bit-identical for ANY device count,
+    asserted in ``dryrun_multichip``.
+    """
+    from jax.sharding import NamedSharding
+
+    from raft_tpu.core.resources import ensure
+    from raft_tpu.neighbors import cagra, nn_descent
+
+    res = ensure(res)
+    mesh, axis = comms.mesh, comms.axis
+    size = comms.get_size()
+    # the returned Index keeps the caller's dtype (bf16/int8 datasets stay
+    # low-precision, as in cagra.build); only the GNND batch stack is f32
+    dataset_orig = dataset if isinstance(dataset, np.ndarray) \
+        else jnp.asarray(dataset)
+    dataset_np = np.asarray(dataset, np.float32)
+    n, d = dataset_np.shape
+    inter = min(params.intermediate_graph_degree, n - 1)
+    nnd = nn_descent.IndexParams(
+        graph_degree=inter,
+        intermediate_graph_degree=min(
+            n - 1, max(inter + inter // 2, inter + 8)
+        ),
+        max_iterations=params.nn_descent_niter,
+        metric=params.metric,
+        seed=params.seed,
+    )
+    # force=True: a single-batch dataset takes the same SPMD path (and
+    # the same split-invariance guarantee) as the multi-batch case;
+    # plan_batches also owns the L2-only metric guard (the far sentinel
+    # has no IP/cosine analog)
+    plan = nn_descent.plan_batches(
+        nnd, dataset_np, max_cluster_rows=max_cluster_rows, force=True,
+        res=res,
+    )
+    batches, pad_m, k_out = plan["batches"], plan["pad_m"], plan["k_out"]
+    lp = plan["local_params"]
+    metric = DISTANCE_TYPES[lp.metric]
+    k_inter = min(lp.intermediate_graph_degree, pad_m - 1)
+    sample = lp.sample_size or min(k_inter, 16)
+    c = sample * k_inter + sample
+    tile = max(1, min(pad_m, res.workspace_rows(4 * c * (d + 4), cap=4096)))
+
+    B = len(batches)
+    B_pad = -(-B // size) * size
+    stack = np.empty((B_pad, pad_m, d), np.float32)
+    for b in range(B_pad):
+        # tail padding repeats the last batch; its outputs are discarded
+        stack[b] = nn_descent.pad_batch(
+            dataset_np, batches[min(b, B - 1)], plan
+        )
+    base = jax.random.PRNGKey(lp.seed)
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.arange(B_pad, dtype=jnp.int32)
+    )
+
+    def one(args):
+        x1, key1 = args
+        gi, gd = nn_descent.gnnd_fixed(
+            key1, x1, metric=metric, k=k_inter, sample=sample,
+            tile=tile, iters=lp.max_iterations,
+        )
+        return gi[:, :k_out], gd[:, :k_out]
+
+    def local(xb, kb):
+        return lax.map(one, (xb, kb))
+
+    f = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None)),
+        out_specs=(P(axis, None, None), P(axis, None, None)),
+        check_vma=False,
+    )
+    # device_put straight from numpy: each device receives ONLY its shard
+    # (an intermediate jnp.asarray would commit the whole ~2x-dataset
+    # stack to one device first — the OOM this MNMG build exists to avoid)
+    xs = jax.device_put(stack, NamedSharding(mesh, P(axis, None, None)))
+    ks = jax.device_put(keys, NamedSharding(mesh, P(axis, None)))
+    gi_all, gd_all = f(xs, ks)
+    gi_np, gd_np = np.asarray(gi_all), np.asarray(gd_all)
+
+    g_ids = np.full((n, k_out), -1, np.int32)
+    g_dists = np.full((n, k_out), np.inf, np.float32)
+    for b, rows in enumerate(batches):
+        nn_descent.merge_local_graph(
+            g_ids, g_dists, rows, gi_np[b], gd_np[b], plan
+        )
+    knn = nn_descent.finalize_global_graph(g_ids, g_dists).graph
+    # shared finalize (optimize + entry table + one dtype-preserving
+    # upload) keeps the MNMG index identical in construction to
+    # cagra.build's
+    return cagra.finalize_index(params, dataset_orig, knn, res=res)
+
+
 def kmeans_step(
     comms: Comms,
     data_sharded: jax.Array,

@@ -367,7 +367,21 @@ def build(
     else:
         raise ValueError(f"unknown build_algo {params.build_algo}")
 
-    graph = optimize(knn_graph, degree, res=res)
+    return finalize_index(params, dataset, knn_graph, res=res)
+
+
+def finalize_index(params: IndexParams, dataset, knn_graph,
+                   *, res: Optional[Resources] = None) -> Index:
+    """Shared index finalization (single-device ``build`` AND the MNMG
+    ``comms.distributed.sharded_cagra_build``): optimize the kNN graph to
+    the output degree, upload the dataset ONCE in its input dtype, build
+    the coarse entry-point table."""
+    res = ensure(res)
+    n = dataset.shape[0]
+    metric = DISTANCE_TYPES[params.metric]
+    inter = min(params.intermediate_graph_degree, n - 1)
+    degree = min(params.graph_degree, inter)
+    graph = optimize(jnp.asarray(knn_graph, jnp.int32), degree, res=res)
     # the index itself is device-resident (search gathers from it); a
     # host build input uploads exactly once, here
     dataset = jnp.asarray(dataset)
@@ -381,8 +395,8 @@ def build(
             dataset, n_entries, metric, params.seed, res
         )
     _log.debug(
-        "cagra.build: n=%d dim=%d degree=%d algo=%s dtype=%s entries=%d",
-        n, d, graph.shape[1], algo, dataset.dtype, n_entries,
+        "cagra.finalize: n=%d degree=%d dtype=%s entries=%d",
+        n, graph.shape[1], dataset.dtype, n_entries,
     )
     return Index(params.metric, dataset, graph, entry_centers, entry_ids)
 

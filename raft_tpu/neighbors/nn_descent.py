@@ -159,6 +159,45 @@ def _nn_descent_iter(key, dataset, graph_ids, graph_dists, metric: str,
     return graph_ids, graph_dists, updates
 
 
+def _init_graph(k_init, dataset, metric: str, k: int):
+    """Random init graph (ref: GnndGraph random init), deduped so the
+    merge invariants hold."""
+    n = dataset.shape[0]
+    init = jax.random.randint(k_init, (n, k), 0, n, jnp.int32)
+    init = jnp.where(init == jnp.arange(n, dtype=jnp.int32)[:, None],
+                     (init + 1) % n, init)
+    vecs = dataset[init]
+    dists = _row_distance(dataset, vecs, metric)
+    graph_ids, graph_dists, _ = _merge_dedup(
+        init, dists, jnp.full_like(init, -1), jnp.full_like(dists, jnp.inf), k
+    )
+    return graph_ids, graph_dists
+
+
+def gnnd_fixed(
+    key, dataset, *, metric: str, k: int, sample: int, tile: int, iters: int
+):
+    """Traceable fixed-iteration GNND (no early-exit host sync) — the
+    per-batch worker the sharded CAGRA graph build maps over mesh devices
+    (comms.distributed.sharded_cagra_build). Same iteration body as
+    :func:`build`; the update-count early exit is dropped because SPMD
+    workers must run a uniform program."""
+    k_init, key = jax.random.split(key)
+    graph_ids, graph_dists = _init_graph(k_init, dataset, metric, k)
+
+    def step(carry, k_it):
+        g_i, g_d = carry
+        g_i, g_d, _ = _nn_descent_iter(
+            k_it, dataset, g_i, g_d, metric, sample, tile
+        )
+        return (g_i, g_d), None
+
+    (graph_ids, graph_dists), _ = lax.scan(
+        step, (graph_ids, graph_dists), jax.random.split(key, iters)
+    )
+    return graph_ids, graph_dists
+
+
 @traced("nn_descent.build")
 def build(
     params: IndexParams,
@@ -178,17 +217,7 @@ def build(
 
     key = jax.random.PRNGKey(params.seed)
     k_init, key = jax.random.split(key)
-
-    # random init graph (ref: GnndGraph random init)
-    init = jax.random.randint(k_init, (n, k), 0, n, jnp.int32)
-    init = jnp.where(init == jnp.arange(n, dtype=jnp.int32)[:, None],
-                     (init + 1) % n, init)
-    vecs = dataset[init]
-    dists = _row_distance(dataset, vecs, metric)
-    # dedupe the random init so merge invariants hold
-    graph_ids, graph_dists, _ = _merge_dedup(
-        init, dists, jnp.full_like(init, -1), jnp.full_like(dists, jnp.inf), k
-    )
+    graph_ids, graph_dists = _init_graph(k_init, dataset, metric, k)
 
     # tile sized so the [tile, c, d] gather fits the workspace
     c = sample * k + sample
@@ -233,21 +262,70 @@ def build_batch(
     gathered per cluster); L2 metrics only (the far-sentinel padding has
     no inner-product analog).
     """
+    res = ensure(res)
+    dataset = np.asarray(dataset)
+    plan = plan_batches(
+        params, dataset, n_clusters=n_clusters,
+        max_cluster_rows=max_cluster_rows, res=res,
+    )
+    if plan is None:
+        return build(params, jnp.asarray(dataset), res=res)
+    return _run_batches(params, dataset, plan, res)
+
+
+def plan_batches(
+    params: IndexParams,
+    dataset: np.ndarray,
+    *,
+    n_clusters: int = 0,
+    max_cluster_rows: int = 65_536,
+    force: bool = False,
+    res: Optional[Resources] = None,
+):
+    """Host-side half of the batch build: balanced-kmeans clustering,
+    top-2 assignment (with skew re-splits), one padded batch shape.
+    Returns the plan dict the batch executors consume (``build_batch``'s
+    sequential loop and ``comms.distributed.sharded_cagra_build``'s
+    mesh-parallel map). When one cluster suffices, returns None —
+    ``build_batch`` then prefers the plain early-exit GNND — unless
+    ``force`` asks for a single-batch plan (the sharded executor always
+    wants a plan so the same SPMD path runs regardless of scale)."""
     from raft_tpu.cluster import kmeans_balanced
     from raft_tpu.neighbors._common import subsample_trainset
 
-    res = ensure(res)
     metric = DISTANCE_TYPES[params.metric]
     if metric not in ("sqeuclidean", "euclidean"):
-        raise ValueError(
-            f"build_batch supports L2 metrics, got {params.metric}"
-        )
-    dataset = np.asarray(dataset)
+        # the far-sentinel padding has no inner-product/cosine analog:
+        # under -ip a huge-coordinate sentinel is every row's BEST
+        # neighbor and would evict real edges
+        raise ValueError(f"batch GNND supports L2 metrics, got {params.metric}")
+    res = ensure(res)
     n, d = dataset.shape
     # each row lands in 2 clusters → rows/cluster ≈ 2n/c
     n_clusters = n_clusters or max(1, -(-2 * n // max_cluster_rows))
     if n_clusters <= 1:
-        return build(params, jnp.asarray(dataset), res=res)
+        if not force:
+            return None
+        k_out = min(
+            params.graph_degree, params.intermediate_graph_degree, n - 1
+        )
+        return {
+            "batches": [np.arange(n, dtype=np.int64)],
+            "pad_m": n,
+            "sentinel": np.zeros((d,), np.float32),
+            "k_out": k_out,
+            "local_params": IndexParams(
+                graph_degree=k_out,
+                intermediate_graph_degree=min(
+                    params.intermediate_graph_degree, n - 1
+                ),
+                max_iterations=params.max_iterations,
+                termination_threshold=params.termination_threshold,
+                metric=params.metric,
+                sample_size=params.sample_size,
+                seed=params.seed,
+            ),
+        }
 
     @functools.partial(jax.jit, static_argnames=())
     def _top2(xt, c):
@@ -310,8 +388,6 @@ def build_batch(
         params.graph_degree, params.intermediate_graph_degree,
         pad_m - 1, n - 1,
     )
-    g_ids = np.full((n, k_out), -1, np.int32)
-    g_dists = np.full((n, k_out), np.inf, np.float32)
 
     local_params = IndexParams(
         graph_degree=k_out,
@@ -325,47 +401,65 @@ def build_batch(
         seed=params.seed,
     )
 
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def _merge(gi, gd, ci, cd, k: int):
-        ids, dists, _ = _merge_dedup(gi, gd, ci, cd, k)
-        return ids, dists
-
     batches = []
     for cid in range(n_clusters):
         all_rows = rows_of[order[starts[cid]:starts[cid + 1]]]
         for cs in range(0, all_rows.shape[0], pad_m):
-            batches.append(all_rows[cs:cs + pad_m])
-    for rows in batches:
-        m = rows.shape[0]
-        if m == 0:
-            continue
-        xc = np.empty((pad_m, d), np.float32)
-        xc[:m] = dataset[rows]
-        xc[m:] = sentinel
-        # ref build_and_merge: local GNND on the cluster subset
-        local = build(local_params, jnp.asarray(xc), res=res)
-        li = np.asarray(local.graph)                     # [pad_m, k] local
-        ld = np.asarray(local.distances)
-        # map local → global; sentinel/padding neighbors drop to −1
-        gi_cand = np.full((pad_m, k_out), -1, np.int32)
-        gi_cand[:m] = np.where(
-            (li[:m] >= 0) & (li[:m] < m), rows[np.clip(li[:m], 0, m - 1)], -1
-        )
-        ld = np.where(gi_cand >= 0, ld, np.inf).astype(np.float32)
-        # a row may appear in both of its clusters under its own id —
-        # merge dedup keeps the best copy (ref merge_subgraphs). The
-        # merge runs at the padded shape too (one compiled program).
-        old_i = np.full((pad_m, k_out), -1, np.int32)
-        old_d = np.full((pad_m, k_out), np.inf, np.float32)
-        old_i[:m] = g_ids[rows]
-        old_d[:m] = g_dists[rows]
-        mi, md = _merge(
-            jnp.asarray(old_i), jnp.asarray(old_d),
-            jnp.asarray(gi_cand), jnp.asarray(ld), k_out,
-        )
-        g_ids[rows] = np.asarray(mi)[:m]
-        g_dists[rows] = np.asarray(md)[:m]
-    # self edges can sneak in via the duplicate cluster memberships
+            chunk = all_rows[cs:cs + pad_m]
+            if chunk.shape[0]:
+                batches.append(chunk)
+    return {
+        "batches": batches, "pad_m": pad_m, "sentinel": sentinel,
+        "k_out": k_out, "local_params": local_params,
+    }
+
+
+def pad_batch(dataset: np.ndarray, rows: np.ndarray, plan) -> np.ndarray:
+    """Materialize one batch at the plan's padded shape (sentinel rows
+    fill the tail)."""
+    m = rows.shape[0]
+    xc = np.empty((plan["pad_m"], dataset.shape[1]), np.float32)
+    xc[:m] = dataset[rows]
+    xc[m:] = plan["sentinel"]
+    return xc
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _merge_jit(gi, gd, ci, cd, k: int):
+    ids, dists, _ = _merge_dedup(gi, gd, ci, cd, k)
+    return ids, dists
+
+
+def merge_local_graph(g_ids, g_dists, rows, li, ld, plan):
+    """Fold one batch's local GNND graph into the host-resident global
+    graph (local ids → global row ids; padding/sentinel neighbors drop to
+    −1; dedup keeps the best copy of rows that live in both of their
+    top-2 clusters — ref merge_subgraphs). Mutates g_ids/g_dists."""
+    pad_m, k_out = plan["pad_m"], plan["k_out"]
+    m = rows.shape[0]
+    li = np.asarray(li)
+    ld = np.asarray(ld)
+    gi_cand = np.full((pad_m, k_out), -1, np.int32)
+    gi_cand[:m] = np.where(
+        (li[:m] >= 0) & (li[:m] < m), rows[np.clip(li[:m], 0, m - 1)], -1
+    )
+    ld = np.where(gi_cand >= 0, ld, np.inf).astype(np.float32)
+    old_i = np.full((pad_m, k_out), -1, np.int32)
+    old_d = np.full((pad_m, k_out), np.inf, np.float32)
+    old_i[:m] = g_ids[rows]
+    old_d[:m] = g_dists[rows]
+    mi, md = _merge_jit(
+        jnp.asarray(old_i), jnp.asarray(old_d),
+        jnp.asarray(gi_cand), jnp.asarray(ld), k_out,
+    )
+    g_ids[rows] = np.asarray(mi)[:m]
+    g_dists[rows] = np.asarray(md)[:m]
+
+
+def finalize_global_graph(g_ids: np.ndarray, g_dists: np.ndarray) -> Index:
+    """Drop self edges (possible via duplicate cluster memberships), sort
+    each row by distance, wrap as an Index."""
+    n = g_ids.shape[0]
     self_col = g_ids == np.arange(n, dtype=np.int32)[:, None]
     g_dists = np.where(self_col, np.inf, g_dists)
     g_ids = np.where(self_col, -1, g_ids)
@@ -373,6 +467,22 @@ def build_batch(
     g_ids = np.take_along_axis(g_ids, order2, axis=1)
     g_dists = np.take_along_axis(g_dists, order2, axis=1)
     return Index(graph=jnp.asarray(g_ids), distances=jnp.asarray(g_dists))
+
+
+def _run_batches(params, dataset, plan, res) -> Index:
+    """Sequential batch executor: one padded cluster resident at a time."""
+    n = dataset.shape[0]
+    k_out = plan["k_out"]
+    g_ids = np.full((n, k_out), -1, np.int32)
+    g_dists = np.full((n, k_out), np.inf, np.float32)
+    for rows in plan["batches"]:
+        xc = pad_batch(dataset, rows, plan)
+        # ref build_and_merge: local GNND on the cluster subset
+        local = build(plan["local_params"], jnp.asarray(xc), res=res)
+        merge_local_graph(
+            g_ids, g_dists, rows, local.graph, local.distances, plan
+        )
+    return finalize_global_graph(g_ids, g_dists)
 
 
 def build_exact(
