@@ -141,7 +141,8 @@ def _cases() -> List[Dict]:
     # schedule-comparable "effective" rate, not measured HBM bandwidth
     scan_bytes = 4096 * 32 * (100_000 // 1024) * 96 * 2
     for strat, pallas in (
-        ("query_major", False), ("probe_major", False), ("probe_major", True)
+        ("query_major", False), ("query_major", True),
+        ("probe_major", False), ("probe_major", True),
     ):
         sp = _pq.SearchParams(n_probes=32, strategy=strat)
 

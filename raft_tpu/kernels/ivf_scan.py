@@ -72,6 +72,69 @@ def pack_list_filter(list_index: jax.Array, filter_words: jax.Array):
     return jnp.sum(ok << shifts, axis=2).astype(jnp.uint32)
 
 
+def _score_against_list(dec, qg, q2, y2_row, ids_row, filt_row, scale,
+                        *, metric: str, filtered: bool, scan_dtype: str):
+    """Score a query block against one list's rows — the shared inner
+    piece of both fused schedules. ``dec`` [cap, rot] (any storage dtype),
+    ``qg`` [G, rot] f32, ``q2`` [G, 1] f32 (+inf marks padding queries),
+    ``y2_row``/``ids_row`` [1, cap], ``filt_row`` [1, cap_w] uint32.
+    Returns (scores [G, cap] with invalid slots at +inf, cand_i [G, cap])."""
+    G = qg.shape[0]
+    cap = dec.shape[0]
+    if dec.dtype == jnp.int8:
+        q_i8, sq = quantize_queries_i8(qg)               # [G, rot], [G, 1]
+        ip_i32 = jax.lax.dot_general(
+            q_i8, dec,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )                                                # [G, cap]
+        ip = ip_i32.astype(jnp.float32) * (sq * scale)
+    else:
+        # MXU: [G, rot] × [cap, rot]ᵀ; stored rows upcast in VMEM only.
+        # scan_dtype mirrors the caller's XLA schedule so the two legs
+        # rank ties the same way: "highest" = f32 + HIGHEST (ivf_flat /
+        # pairwise._PREC), "float32"/"bfloat16" = the ivf_pq lut_dtype
+        # ladder at MXU default precision
+        sd = jnp.bfloat16 if scan_dtype == "bfloat16" else jnp.float32
+        ip = jax.lax.dot_general(
+            qg.astype(sd), dec.astype(sd),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=(
+                jax.lax.Precision.HIGHEST if scan_dtype == "highest"
+                else jax.lax.Precision.DEFAULT
+            ),
+        )                                                # [G, cap]
+    if metric == "inner_product":
+        scores = -ip
+    elif metric == "cosine":
+        # same guards as the XLA leg (ivf_flat score_fn): rsqrt with the
+        # floors keeps padding (+inf q2 → rsqrt→0) and zero rows finite
+        qn_inv = jax.lax.rsqrt(jnp.maximum(q2, 1e-24))   # [G, 1]
+        vn_inv = jax.lax.rsqrt(jnp.maximum(y2_row, 1e-24))  # [1, cap]
+        scores = 1.0 - ip * qn_inv * vn_inv
+    else:
+        scores = y2_row - 2.0 * ip + q2                  # [G, cap]
+    invalid = (ids_row < 0) | jnp.isinf(q2)              # [G, cap]
+    if filtered:
+        cap_w = filt_row.shape[1]
+        # lane-oriented expansion: repeat each word across its 32 lanes
+        # (broadcast + minormost reshape — the only reshape shape Mosaic
+        # lowers cheaply), then shift by lane position % 32
+        rep = jnp.broadcast_to(
+            filt_row[:, :, None], (1, cap_w, 32)
+        ).reshape(1, cap_w * 32)
+        shifts = (
+            jax.lax.broadcasted_iota(jnp.uint32, (1, cap_w * 32), 1)
+            % jnp.uint32(32)
+        )
+        passing = ((rep >> shifts) & 1)[:, :cap] == 1    # [1, cap]
+        invalid = invalid | ~passing
+    scores = jnp.where(invalid, _WORST, scores)
+    cand_i = jnp.broadcast_to(ids_row, (G, cap))
+    return scores, cand_i
+
+
 def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
                  q2_ref, scale_ref, vals_ref, out_ids_ref, *, kk: int,
                  metric: str, filtered: bool, scan_dtype: str):
@@ -87,65 +150,14 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
     (−ip); ``filtered`` expands the list's packed filter words to a lane
     mask and demotes failing slots."""
     G = qg_ref.shape[1]
-    cap = dec_ref.shape[1]
-    if dec_ref.dtype == jnp.int8:
-        q_i8, sq = quantize_queries_i8(qg_ref[0])        # [G, rot], [G, 1]
-        ip_i32 = jax.lax.dot_general(
-            q_i8, dec_ref[0],
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )                                                # [G, cap]
-        ip = ip_i32.astype(jnp.float32) * (sq * scale_ref[0, 0])
-    else:
-        # MXU: [G, rot] × [cap, rot]ᵀ; the stored rows upcast in VMEM (one
-        # [cap, rot] tile), never as a full-index HBM copy.  scan_dtype
-        # mirrors the caller's XLA schedule so the two legs rank ties the
-        # same way: "highest" = f32 + HIGHEST (ivf_flat / pairwise._PREC),
-        # "float32"/"bfloat16" = the ivf_pq lut_dtype ladder at MXU
-        # default precision
-        sd = jnp.bfloat16 if scan_dtype == "bfloat16" else jnp.float32
-        ip = jax.lax.dot_general(
-            qg_ref[0].astype(sd), dec_ref[0].astype(sd),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=(
-                jax.lax.Precision.HIGHEST if scan_dtype == "highest"
-                else jax.lax.Precision.DEFAULT
-            ),
-        )                                                # [G, cap]
     # Mosaic lowering: every vector op stays 2-D — q2 rides as a [G, 1]
     # column block and y2/ids as [1, cap] rows, so the masks build from
     # plain 2-D broadcasts (1-D reshapes/transposes crash tpu_compile)
-    q2 = q2_ref[0]                                       # [G, 1]
-    if metric == "inner_product":
-        scores = -ip
-    elif metric == "cosine":
-        # same guards as the XLA leg (ivf_flat score_fn): rsqrt with the
-        # floors keeps padding (+inf q2 → rsqrt→0) and zero rows finite
-        qn_inv = jax.lax.rsqrt(jnp.maximum(q2, 1e-24))   # [G, 1]
-        vn_inv = jax.lax.rsqrt(jnp.maximum(y2_ref[0], 1e-24))  # [1, cap]
-        scores = 1.0 - ip * qn_inv * vn_inv
-    else:
-        scores = y2_ref[0] - 2.0 * ip + q2               # [G, cap]
-    ids_row = ids_ref[0]                                 # [1, cap]
-    invalid = (ids_row < 0) | jnp.isinf(q2)              # [G, cap]
-    if filtered:
-        words = filt_ref[0]                              # [1, cap_w] uint32
-        cap_w = words.shape[1]
-        # lane-oriented expansion: repeat each word across its 32 lanes
-        # (broadcast + minormost reshape — the only reshape shape Mosaic
-        # lowers cheaply), then shift by lane position % 32
-        rep = jnp.broadcast_to(
-            words[:, :, None], (1, cap_w, 32)
-        ).reshape(1, cap_w * 32)
-        shifts = (
-            jax.lax.broadcasted_iota(jnp.uint32, (1, cap_w * 32), 1)
-            % jnp.uint32(32)
-        )
-        passing = ((rep >> shifts) & 1)[:, :cap] == 1    # [1, cap]
-        invalid = invalid | ~passing
-    scores = jnp.where(invalid, _WORST, scores)
-    cand_i = jnp.broadcast_to(ids_row, (G, cap))
+    scores, cand_i = _score_against_list(
+        dec_ref[0], qg_ref[0], q2_ref[0], y2_ref[0], ids_ref[0],
+        filt_ref[0], scale_ref[0, 0],
+        metric=metric, filtered=filtered, scan_dtype=scan_dtype,
+    )
     run_v = jnp.full((G, kk), _WORST, jnp.float32)
     run_i = jnp.full((G, kk), -1, jnp.int32)
     v, i = fold_topk(run_v, run_i, scores, cand_i, kk)
@@ -233,3 +245,144 @@ def ivf_scan_probe_major(
         jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),
     )
     return vals, ids
+
+
+def _scan_qm_kernel(probes_ref, dec_ref, y2_ref, ids_ref, filt_ref, q_ref,
+                    q2_ref, scale_ref, vals_ref, out_ids_ref, s_v, s_i, *,
+                    kk: int, metric: str, filtered: bool, scan_dtype: str,
+                    P: int, G: int, cap: int):
+    """One (query-block, probe, member) step of the fused query-major
+    scan: score member ``i``'s probe-``p`` list into the block's VMEM
+    score scratch; after the block's last (p, i) step, ONE fold over the
+    whole [G, P*cap] pool extracts every member's top-kk.  The G-wide
+    fold is the point: a per-query fold would waste 7 of 8 sublanes and
+    dominate the kernel (measured reasoning in ROUND4_NOTES); batching G
+    queries' pools through fold_topk amortizes it G-fold."""
+    p = pl.program_id(1)
+    i = pl.program_id(2)
+    scores, cand_i = _score_against_list(
+        dec_ref[0], q_ref[0], q2_ref[0], y2_ref[0], ids_ref[0],
+        filt_ref[0], scale_ref[0, 0],
+        metric=metric, filtered=filtered, scan_dtype=scan_dtype,
+    )                                                    # [1, cap] each
+    s_v[i, p, :] = scores[0]
+    s_i[i, p, :] = cand_i[0]
+
+    @pl.when((p == P - 1) & (i == G - 1))
+    def _fold():
+        pool_v = s_v[...].reshape(G, P * cap)
+        pool_i = s_i[...].reshape(G, P * cap)
+        run_v = jnp.full((G, kk), _WORST, jnp.float32)
+        run_i = jnp.full((G, kk), -1, jnp.int32)
+        v, o = fold_topk(run_v, run_i, pool_v, pool_i, kk)
+        o = jnp.where(jnp.isfinite(v), o, -1)
+        vals_ref[0] = v
+        out_ids_ref[0] = o
+
+
+#: query-block width of the fused query-major scan — one full sublane set
+_QM_GROUP = 8
+
+
+def qm_scratch_bytes(n_probes: int, cap: int) -> int:
+    """VMEM score+id scratch the query-major kernel allocates per block —
+    the dispatch gates on this (one owner for the formula and _QM_GROUP)."""
+    return 2 * _QM_GROUP * n_probes * cap * 4
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kk", "metric", "scan_dtype", "interpret")
+)
+def ivf_scan_query_major(
+    probes: jax.Array,        # [Q, P] int32 — per-query probed list ids
+    q_rot: jax.Array,         # [Q, rot] f32 — rotated queries
+    q2: jax.Array,            # [Q] f32 — ‖q_rot‖² (+inf marks padding)
+    list_data: jax.Array,     # [L, cap, rot] f32/bf16/int8 stored rows
+    list_y2: jax.Array,       # [L, cap] f32
+    list_index: jax.Array,    # [L, cap] int32
+    kk: int,
+    *,
+    metric: str = "sqeuclidean",
+    scan_dtype: str = "highest",
+    list_filter: jax.Array | None = None,  # [L, ceil(cap/32)] uint32
+    scan_scale: float = 1.0,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused query-major IVF scan: each query's probed lists stream
+    straight from the index into VMEM (the XLA schedule's materialized
+    [t, p, cap, rot] gather copy and [t, p, cap] score tensor never
+    exist), scores accumulate in a per-block VMEM scratch, and one
+    G-wide fold per query block extracts the top-kk.  Returns
+    (vals [Q, kk], ids [Q, kk]) raw score partials — same conventions as
+    the XLA query-major leg pre-postprocess.  Q must be a multiple of
+    the group width (pad with q2=+inf rows; their outputs are -1/inf).
+
+    VMEM budget: the scratch holds 2·G·P·cap·4 bytes — callers gate on
+    this (see ivf_pq's dispatch) and fall back to XLA past it."""
+    Q, P = probes.shape
+    L, cap, rot = list_data.shape
+    G = _QM_GROUP
+    if Q % G:
+        raise ValueError(f"Q={Q} must be a multiple of {G} (pad upstream)")
+    filtered = list_filter is not None
+    if not filtered:
+        list_filter = jnp.zeros((L, 1), jnp.uint32)
+    cap_w = list_filter.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Q // G, P, G),
+        in_specs=[
+            pl.BlockSpec(       # dec: member i's probe-p list (dynamic)
+                (1, cap, rot),
+                lambda qb, p, i, pr: (pr[(qb * G + i) * P + p], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, cap),
+                lambda qb, p, i, pr: (pr[(qb * G + i) * P + p], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, cap),
+                lambda qb, p, i, pr: (pr[(qb * G + i) * P + p], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, cap_w),
+                lambda qb, p, i, pr: (pr[(qb * G + i) * P + p], 0, 0),
+            ),
+            pl.BlockSpec(       # member i's query row
+                (1, 1, rot), lambda qb, p, i, pr: (qb * G + i, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, 1), lambda qb, p, i, pr: (qb * G + i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),       # scan_scale
+        ],
+        out_specs=[
+            pl.BlockSpec((1, G, kk), lambda qb, p, i, pr: (qb, 0, 0)),
+            pl.BlockSpec((1, G, kk), lambda qb, p, i, pr: (qb, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((G, P, cap), jnp.float32),
+            pltpu.VMEM((G, P, cap), jnp.int32),
+        ],
+    )
+    vals, ids = pl.pallas_call(
+        functools.partial(
+            _scan_qm_kernel, kk=kk, metric=metric, filtered=filtered,
+            scan_dtype=scan_dtype, P=P, G=G, cap=cap,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((Q // G, G, kk), jnp.float32),
+            jax.ShapeDtypeStruct((Q // G, G, kk), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        probes.reshape(-1),
+        list_data,
+        list_y2[:, None, :],
+        list_index[:, None, :],
+        list_filter[:, None, :],
+        q_rot[:, None, :],
+        q2[:, None, None],
+        jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),
+    )
+    return vals.reshape(Q, kk), ids.reshape(Q, kk)

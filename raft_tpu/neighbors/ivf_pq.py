@@ -97,6 +97,11 @@ _DECODED_DTYPES = {
 #: decode chunk)
 _AUTO_HBM_FRACTION = 0.55
 
+#: ceiling for the fused query-major kernel's per-block VMEM score
+#: scratch (kernels/ivf_scan.qm_scratch_bytes); past it the XLA leg's
+#: host tiling wins. Tune from the on-chip ivf_scan_ab sweep.
+_QM_VMEM_BUDGET = 6 * 1024 * 1024
+
 
 def _device_memory_budget() -> tuple[int, bool]:
     """Bytes of accelerator memory to plan against, and whether that number
@@ -1200,6 +1205,48 @@ def _search_probe_major_pallas(
     return v, i
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_probes", "k", "metric", "scan_dtype", "interpret"
+    ),
+)
+def _search_query_major_pallas(
+    queries, centers, rotation, list_data, list_y2, list_index,
+    list_filter, scan_scale, n_probes: int, k: int, metric: str,
+    scan_dtype: str, interpret: bool,
+):
+    """Query-major schedule with the fused Pallas scan
+    (kernels/ivf_scan.ivf_scan_query_major): probed lists stream from
+    the index straight into VMEM — the XLA leg's materialized
+    [t, p, cap, rot] gather copy and [t, p, cap] score tensor (2× the
+    whole scanned volume in extra HBM traffic) never exist.  Queries pad
+    to the kernel's group width with q2=+inf rows (outputs -1, sliced
+    off)."""
+    from raft_tpu.kernels.ivf_scan import _QM_GROUP, ivf_scan_query_major
+
+    q, _ = queries.shape
+    probes = coarse_select(queries, centers, metric, n_probes)
+    q_rot = jnp.matmul(queries, rotation.T, precision=_PREC)
+    q2 = jnp.sum(q_rot * q_rot, axis=1)
+    pad = (-q) % _QM_GROUP
+    if pad:
+        probes = jnp.pad(probes, ((0, pad), (0, 0)))
+        q_rot = jnp.pad(q_rot, ((0, pad), (0, 0)))
+        q2 = jnp.pad(q2, (0, pad), constant_values=jnp.inf)
+    v, i = ivf_scan_query_major(
+        probes, q_rot, q2, list_data, list_y2, list_index, int(k),
+        metric=metric, scan_dtype=scan_dtype, list_filter=list_filter,
+        scan_scale=scan_scale, interpret=interpret,
+    )
+    v, i = v[:q], i[:q]
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
 @traced("ivf_pq.search")
 def search(
     params: SearchParams,
@@ -1284,6 +1331,32 @@ def search(
         # host-level query batching bounds the merge buffers (pair
         # partials are O(q·p·k); see select_scan_strategy)
         return run_query_tiled(run_pm, queries, q_tile)
+    from raft_tpu.kernels.ivf_scan import qm_scratch_bytes
+
+    if (
+        pallas_scan_enabled(canonical, index.list_data.dtype, allow_int8=True)
+        and params.internal_distance_dtype == "float32"
+        # the fused kernel's per-block score scratch must fit VMEM
+        # comfortably; past that the XLA leg tiles better
+        and qm_scratch_bytes(n_probes, index.list_cap) <= _QM_VMEM_BUDGET
+    ):
+        from raft_tpu.kernels import interpret_mode
+        from raft_tpu.kernels.ivf_scan import pack_list_filter
+
+        lf = None if fw is None else pack_list_filter(index.list_index, fw)
+
+        def run_qm(qt):
+            return _search_query_major_pallas(
+                qt, index.centers, index.rotation, index.list_data,
+                index.list_y2, index.list_index, lf,
+                float(index.scan_scale), n_probes, int(k), canonical,
+                params.lut_dtype, interpret_mode(),
+            )
+
+        # host-level query tiling bounds the scalar-prefetch operand
+        # (q_tile·P int32 must stay SMEM-small) like every other leg
+        qm_tile = max(8, min(4096, (32_768 // max(1, n_probes)) // 8 * 8))
+        return run_query_tiled(run_qm, queries, qm_tile)
     # per-query workspace: probe gather of decoded rows + scores + ids
     if index.list_data.dtype == jnp.int8:
         itemsize = 1

@@ -185,6 +185,35 @@ class TestPallasCompilesOnTpu:
             os.environ.pop("RAFT_TPU_PALLAS", None)
         assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
 
+    @pytest.mark.parametrize("decoded_dtype", ["bfloat16", "int8"])
+    def test_ivf_scan_query_major_compiles(self, decoded_dtype):
+        """The query-major kernel adds a 3-axis grid, VMEM score scratch,
+        and a group-end fold — Mosaic must take all three."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(5)
+        x, _, _ = make_blobs(key, 20000, 96, n_clusters=64, cluster_std=2.0)
+        x = np.asarray(x)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=64, pq_dim=48, kmeans_n_iters=4,
+                decoded_dtype=decoded_dtype,
+            ),
+            x,
+        )
+        q = jnp.asarray(x[:512] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="query_major")
+        v_x, i_x = ivf_pq.search(sp, index, q, 10)
+        import os
+
+        os.environ["RAFT_TPU_PALLAS"] = "1"
+        try:
+            v_p, i_p = ivf_pq.search(sp, index, q, 10)
+        finally:
+            os.environ.pop("RAFT_TPU_PALLAS", None)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+
 
 class TestIvfScanKernel:
     """Fused Pallas probe-major IVF scan (kernels/ivf_scan.py) must agree
@@ -435,3 +464,89 @@ class TestIvfScanKernel:
         np.testing.assert_allclose(
             np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
         )
+
+
+class TestIvfScanQueryMajor:
+    """Fused query-major scan (ivf_scan_query_major) must agree with the
+    XLA query-major schedule (interpret mode; Mosaic leg in
+    TestPallasCompilesOnTpu)."""
+
+    def _index(self, decoded_dtype="bfloat16", n=8000, d=32):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(6)
+        x, _, _ = make_blobs(key, n, d, n_clusters=32, cluster_std=2.0)
+        x = np.asarray(x)
+        return x, ivf_pq.build(
+            ivf_pq.IndexParams(
+                n_lists=32, pq_dim=d // 2, kmeans_n_iters=4,
+                decoded_dtype=decoded_dtype,
+            ), x,
+        )
+
+    def test_matches_xla_query_major(self, monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+
+        x, index = self._index()
+        q = jnp.asarray(x[:301] + 0.01)   # non-multiple of 8: pad leg
+        sp = ivf_pq.SearchParams(n_probes=6, strategy="query_major")
+        v_x, i_x = ivf_pq.search(sp, index, q, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        # prove the fused path dispatches
+        monkeypatch.setattr(
+            ivf_pq, "_search_jit",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("XLA query-major taken despite gate")
+            ),
+        )
+        v_p, i_p = ivf_pq.search(sp, index, q, 10)
+        assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+
+    def test_filtered_and_int8_match_xla(self, monkeypatch):
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_pq
+
+        x, index = self._index()
+        q = jnp.asarray(x[:96] + 0.01)
+        sp = ivf_pq.SearchParams(n_probes=8, strategy="query_major")
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        v_x, i_x = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, index, q, 5, sample_filter=bs)
+        i_p_np = np.asarray(i_p)
+        assert (i_p_np[i_p_np >= 0] % 2 == 0).all()
+        assert (np.asarray(i_x) == i_p_np).mean() >= 0.99
+        # int8 scan cache through the quantized-query leg
+        monkeypatch.delenv("RAFT_TPU_PALLAS")
+        x8, idx8 = self._index(decoded_dtype="int8")
+        q8 = jnp.asarray(x8[:96] + 0.01)
+        v_x8, i_x8 = ivf_pq.search(sp, idx8, q8, 10)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p8, i_p8 = ivf_pq.search(sp, idx8, q8, 10)
+        assert (np.asarray(i_x8) == np.asarray(i_p8)).mean() >= 0.99
+
+    def test_vmem_gate_falls_back(self, monkeypatch):
+        """Past the scratch budget the dispatch must stay on XLA (budget
+        shrunk below any real scratch so the fallback is actually
+        exercised)."""
+        from raft_tpu.neighbors import ivf_pq
+
+        x, index = self._index()
+        q = jnp.asarray(x[:32])
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        monkeypatch.setattr(
+            ivf_pq, "_search_query_major_pallas",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("pallas query-major taken past VMEM gate")
+            ),
+        )
+        monkeypatch.setattr(ivf_pq, "_QM_VMEM_BUDGET", 0)
+        sp = ivf_pq.SearchParams(n_probes=6, strategy="query_major")
+        v, i = ivf_pq.search(sp, index, q, 5)
+        assert np.asarray(i).shape == (32, 5)
