@@ -284,10 +284,23 @@ def _scan_qm_kernel(probes_ref, dec_ref, y2_ref, ids_ref, filt_ref, q_ref,
 _QM_GROUP = 8
 
 
+#: per-block VMEM scratch ceiling for the query-major kernel — the ONE
+#: owner both index dispatches gate on; past it the XLA legs tile better.
+#: Tune from the on-chip ivf_scan_ab sweep.
+QM_VMEM_BUDGET = 6 * 1024 * 1024
+
+
 def qm_scratch_bytes(n_probes: int, cap: int) -> int:
     """VMEM score+id scratch the query-major kernel allocates per block —
     the dispatch gates on this (one owner for the formula and _QM_GROUP)."""
     return 2 * _QM_GROUP * n_probes * cap * 4
+
+
+def qm_query_tile(n_probes: int) -> int:
+    """Host-level query tile for the fused query-major dispatch: bounds
+    the scalar-prefetch operand (q_tile·n_probes int32 must stay
+    SMEM-small), rounded to the kernel group width."""
+    return max(_QM_GROUP, min(4096, (32_768 // max(1, n_probes)) // 8 * 8))
 
 
 @functools.partial(

@@ -553,6 +553,45 @@ def _search_probe_major_pallas(
     return v, i
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "k", "metric", "interpret")
+)
+def _search_query_major_pallas(
+    queries, centers, list_data, list_index, list_norms, list_filter,
+    n_probes: int, k: int, metric: str, interpret: bool,
+):
+    """Query-major schedule with the fused Pallas scan (payload-agnostic
+    kernels/ivf_scan.ivf_scan_query_major — here y² = stored row norms
+    and queries ride unrotated): probed lists stream straight into VMEM;
+    the XLA leg's [t, p, cap, d] gather copy and score tensor never
+    exist. Queries pad to the kernel group width with q2=+inf rows."""
+    from raft_tpu.kernels.ivf_scan import _QM_GROUP, ivf_scan_query_major
+
+    q, d = queries.shape
+    probes = coarse_select(queries, centers, metric, n_probes)
+    q2 = jnp.sum(queries * queries, axis=1)
+    # padding slots carry inf norms; the kernel masks by ids < 0, so zero
+    # them to keep inf out of the MXU product path
+    norms = jnp.where(list_index >= 0, list_norms, 0.0)
+    pad = (-q) % _QM_GROUP
+    if pad:
+        probes = jnp.pad(probes, ((0, pad), (0, 0)))
+        queries = jnp.pad(queries, ((0, pad), (0, 0)))
+        q2 = jnp.pad(q2, (0, pad), constant_values=jnp.inf)
+    v, i = ivf_scan_query_major(
+        probes, queries, q2, list_data, norms, list_index, int(k),
+        metric=metric, scan_dtype="highest", list_filter=list_filter,
+        interpret=interpret,
+    )
+    v, i = v[:q], i[:q]
+    if metric == "inner_product":
+        v = -v
+    elif metric == "euclidean":
+        # kernel folds +‖q‖² into the L2 score, so only the root remains
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
 @traced("ivf_flat.search")
 def search(
     params: SearchParams,
@@ -620,6 +659,30 @@ def search(
         # host-level query batching bounds the merge buffers (see
         # select_scan_strategy)
         return run_query_tiled(run_pm, queries, q_tile)
+    from raft_tpu.kernels import ivf_scan as _scan_mod
+
+    if (
+        pallas_scan_enabled(canonical, index.list_data.dtype)
+        and _scan_mod.qm_scratch_bytes(n_probes, index.list_cap)
+        <= _scan_mod.QM_VMEM_BUDGET
+    ):
+        from raft_tpu.kernels import interpret_mode
+
+        lf = (
+            None if fw is None
+            else _scan_mod.pack_list_filter(index.list_index, fw)
+        )
+
+        def run_qm(qt):
+            return _search_query_major_pallas(
+                qt, index.centers, index.list_data, index.list_index,
+                index.list_norms, lf, n_probes, int(k), canonical,
+                interpret_mode(),
+            )
+
+        return run_query_tiled(
+            run_qm, queries, _scan_mod.qm_query_tile(n_probes)
+        )
     # tile queries so the [t, p, cap, d] gather respects the workspace budget
     per_q = 4 * n_probes * index.list_cap * (index.dim + 2)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=256))))

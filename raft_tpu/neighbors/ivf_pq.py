@@ -97,10 +97,6 @@ _DECODED_DTYPES = {
 #: decode chunk)
 _AUTO_HBM_FRACTION = 0.55
 
-#: ceiling for the fused query-major kernel's per-block VMEM score
-#: scratch (kernels/ivf_scan.qm_scratch_bytes); past it the XLA leg's
-#: host tiling wins. Tune from the on-chip ivf_scan_ab sweep.
-_QM_VMEM_BUDGET = 6 * 1024 * 1024
 
 
 def _device_memory_budget() -> tuple[int, bool]:
@@ -1331,19 +1327,22 @@ def search(
         # host-level query batching bounds the merge buffers (pair
         # partials are O(q·p·k); see select_scan_strategy)
         return run_query_tiled(run_pm, queries, q_tile)
-    from raft_tpu.kernels.ivf_scan import qm_scratch_bytes
+    from raft_tpu.kernels import ivf_scan as _scan_mod
 
     if (
         pallas_scan_enabled(canonical, index.list_data.dtype, allow_int8=True)
         and params.internal_distance_dtype == "float32"
         # the fused kernel's per-block score scratch must fit VMEM
         # comfortably; past that the XLA leg tiles better
-        and qm_scratch_bytes(n_probes, index.list_cap) <= _QM_VMEM_BUDGET
+        and _scan_mod.qm_scratch_bytes(n_probes, index.list_cap)
+        <= _scan_mod.QM_VMEM_BUDGET
     ):
         from raft_tpu.kernels import interpret_mode
-        from raft_tpu.kernels.ivf_scan import pack_list_filter
 
-        lf = None if fw is None else pack_list_filter(index.list_index, fw)
+        lf = (
+            None if fw is None
+            else _scan_mod.pack_list_filter(index.list_index, fw)
+        )
 
         def run_qm(qt):
             return _search_query_major_pallas(
@@ -1353,10 +1352,9 @@ def search(
                 params.lut_dtype, interpret_mode(),
             )
 
-        # host-level query tiling bounds the scalar-prefetch operand
-        # (q_tile·P int32 must stay SMEM-small) like every other leg
-        qm_tile = max(8, min(4096, (32_768 // max(1, n_probes)) // 8 * 8))
-        return run_query_tiled(run_qm, queries, qm_tile)
+        return run_query_tiled(
+            run_qm, queries, _scan_mod.qm_query_tile(n_probes)
+        )
     # per-query workspace: probe gather of decoded rows + scores + ids
     if index.list_data.dtype == jnp.int8:
         itemsize = 1

@@ -546,7 +546,52 @@ class TestIvfScanQueryMajor:
                 AssertionError("pallas query-major taken past VMEM gate")
             ),
         )
-        monkeypatch.setattr(ivf_pq, "_QM_VMEM_BUDGET", 0)
+        from raft_tpu.kernels import ivf_scan
+
+        monkeypatch.setattr(ivf_scan, "QM_VMEM_BUDGET", 0)
         sp = ivf_pq.SearchParams(n_probes=6, strategy="query_major")
         v, i = ivf_pq.search(sp, index, q, 5)
         assert np.asarray(i).shape == (32, 5)
+
+    def test_ivf_flat_query_major_matches_xla(self, monkeypatch):
+        """ivf_flat rides the same payload-agnostic kernel (norms as y²,
+        unrotated queries) — L2, cosine, and filtered IP legs."""
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.random import make_blobs
+
+        key = jax.random.PRNGKey(7)
+        x, _, _ = make_blobs(key, 6000, 32, n_clusters=24, cluster_std=2.0)
+        x = np.asarray(x)
+        q = jnp.asarray(x[:203] + 0.01)
+        sp = ivf_flat.SearchParams(n_probes=6, strategy="query_major")
+        for metric in ("sqeuclidean", "cosine"):
+            idx = ivf_flat.build(
+                ivf_flat.IndexParams(
+                    n_lists=24, kmeans_n_iters=4, metric=metric
+                ), x,
+            )
+            monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+            v_x, i_x = ivf_flat.search(sp, idx, q, 10)
+            monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+            v_p, i_p = ivf_flat.search(sp, idx, q, 10)
+            assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99, metric
+            np.testing.assert_allclose(
+                np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+            )
+        # filtered inner product
+        idx_ip = ivf_flat.build(
+            ivf_flat.IndexParams(
+                n_lists=24, kmeans_n_iters=4, metric="inner_product"
+            ), x,
+        )
+        mask = np.zeros(x.shape[0], bool)
+        mask[::2] = True
+        bs = Bitset.from_mask(jnp.asarray(mask))
+        monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+        v_x, i_x = ivf_flat.search(sp, idx_ip, q, 5, sample_filter=bs)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_flat.search(sp, idx_ip, q, 5, sample_filter=bs)
+        i_p_np = np.asarray(i_p)
+        assert (i_p_np[i_p_np >= 0] % 2 == 0).all()
+        assert (np.asarray(i_x) == i_p_np).mean() >= 0.99
