@@ -174,6 +174,40 @@ def _cases() -> List[Dict]:
             }
         )
 
+    # brute-force kNN A/B: XLA tiled formulation vs the fused Pallas
+    # distance+topk kernel — the promotion evidence for fused_knn
+    # (mirrors ivf_scan_ab; VERDICT r3 item 10)
+    from raft_tpu.neighbors import brute_force as _bf
+
+    bx = jnp.asarray(rng.standard_normal((200_000, 96)).astype(np.float32))
+    bq = jnp.asarray(rng.standard_normal((4096, 96)).astype(np.float32))
+
+    for pallas in (False, True):
+        def bf_fn(xx, qq, _pallas=pallas):
+            prev = os.environ.get("RAFT_TPU_PALLAS")
+            if _pallas:
+                os.environ["RAFT_TPU_PALLAS"] = "1"
+            else:
+                os.environ.pop("RAFT_TPU_PALLAS", None)
+            try:
+                return _bf.knn(xx, qq, 10)
+            finally:
+                if prev is None:
+                    os.environ.pop("RAFT_TPU_PALLAS", None)
+                else:
+                    os.environ["RAFT_TPU_PALLAS"] = prev
+
+        cases.append(
+            {
+                "name": "bf_knn_ab/200kx96/q4096/k10"
+                + ("/pallas" if pallas else "/xla"),
+                "fn": bf_fn,
+                "args": (bx, bq),
+                "bytes": 200_000 * 96 * 4,
+                "flops": 2 * 200_000 * 4096 * 96,
+            }
+        )
+
     # fused L2 argmin — the kmeans inner loop (ref: bench/prims/distance/fused_l2_nn.cu)
     m, n, d = 8192, 1024, 128
     a = jnp.asarray(rng.standard_normal((m, d)).astype(np.float32))
