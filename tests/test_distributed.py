@@ -357,3 +357,22 @@ def test_sharded_cagra_build_split_invariant():
     )
     rec = float(neighborhood_recall(np.asarray(ids), np.asarray(gt)))
     assert rec >= 0.9, rec
+
+
+def test_sharded_cagra_build_rejects_non_l2():
+    """The far-sentinel batch plan has no IP/cosine analog — the guard
+    must fire before any mesh work."""
+    import pytest as _pytest
+
+    from raft_tpu.comms.comms import local_comms
+    from raft_tpu.comms.distributed import sharded_cagra_build
+    from raft_tpu.neighbors import cagra
+
+    x = np.random.default_rng(0).standard_normal((256, 8)).astype(np.float32)
+    with _pytest.raises(ValueError, match="L2"):
+        sharded_cagra_build(
+            local_comms(8),
+            cagra.IndexParams(metric="inner_product", graph_degree=8),
+            x,
+            max_cluster_rows=64,
+        )
