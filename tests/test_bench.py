@@ -367,3 +367,88 @@ def test_cagra_vpq_comparator(ds):
     a = algo(ds.metric, {"graph_degree": 16, "intermediate_graph_degree": 24})
     a.build(ds.base)
     assert isinstance(a._index.dataset, VpqDataset)
+
+
+class TestFetchOverHttp:
+    """The REAL download path (urllib streaming, header rewrite, dtype
+    from source extension) exercised against a localhost HTTP server —
+    the closest an egress-free environment gets to the published
+    big-ann/ann-benchmarks sources (ADVICE r3 medium: this path was
+    never executed at all before)."""
+
+    @staticmethod
+    def _serve(directory):
+        import http.server
+        import socketserver
+        import threading
+
+        handler = lambda *a, **k: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+            *a, directory=directory, **k
+        )
+        srv = socketserver.TCPServer(("127.0.0.1", 0), handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, srv.server_address[1]
+
+    def test_bigann_prefix_stream(self, tmp_path, monkeypatch):
+        """Sliced-prefix download: only `rows` vectors transfer, the
+        header rewrites, dtype comes from the SOURCE extension."""
+        from raft_tpu.bench import datasets, get_dataset
+
+        src = tmp_path / "src"
+        src.mkdir()
+        rng = np.random.default_rng(0)
+        n_total, dim = 500, 16
+        base = rng.standard_normal((n_total, dim)).astype(np.float32)
+        with open(src / "base.fbin", "wb") as f:
+            f.write(np.asarray([n_total, dim], np.int32).tobytes())
+            f.write(base.tobytes())
+        queries = rng.standard_normal((20, dim)).astype(np.float32)
+        with open(src / "query.fbin", "wb") as f:
+            f.write(np.asarray([20, dim], np.int32).tobytes())
+            f.write(queries.tobytes())
+        srv, port = self._serve(str(src))
+        try:
+            monkeypatch.setitem(
+                get_dataset._BIGANN_SOURCES, "deep-100M",
+                (f"http://127.0.0.1:{port}/base.fbin",
+                 f"http://127.0.0.1:{port}/query.fbin", n_total),
+            )
+            out = tmp_path / "out"
+            dest = get_dataset.fetch("deep-100M", str(out), scale=0.4, k=5)
+        finally:
+            srv.shutdown()
+        ds = datasets.load(dest)
+        assert ds.base.shape == (200, dim)            # 0.4 × 500 prefix
+        np.testing.assert_array_equal(ds.base, base[:200])
+        np.testing.assert_array_equal(ds.queries, queries)
+        assert ds.gt_neighbors is not None and ds.gt_neighbors.shape[1] == 5
+        assert ds.base.dtype == np.float32
+
+    def test_hdf5_download(self, tmp_path, monkeypatch):
+        """ann-benchmarks HDF5 leg over the same real urllib path."""
+        h5py = pytest.importorskip("h5py")
+        from raft_tpu.bench import datasets, get_dataset
+
+        src = tmp_path / "src"
+        src.mkdir()
+        rng = np.random.default_rng(1)
+        with h5py.File(src / "toy-16-euclidean.hdf5", "w") as f:
+            f.attrs["distance"] = "euclidean"
+            f["train"] = rng.standard_normal((300, 16)).astype(np.float32)
+            f["test"] = rng.standard_normal((10, 16)).astype(np.float32)
+        srv, port = self._serve(str(src))
+        try:
+            monkeypatch.setattr(
+                get_dataset, "_ANN_BENCHMARKS_URL",
+                f"http://127.0.0.1:{port}/{{name}}.hdf5",
+            )
+            dest = get_dataset.fetch(
+                "toy-16-euclidean", str(tmp_path / "out"), k=4
+            )
+        finally:
+            srv.shutdown()
+        ds = datasets.load(dest)
+        assert ds.base.shape == (300, 16)
+        assert ds.metric == "sqeuclidean"
+        assert ds.gt_neighbors is not None
