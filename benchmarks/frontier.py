@@ -109,6 +109,9 @@ def main() -> None:
             ],
         ),
         ("hnswlib_format", {"graph_degree": 32}, [{"ef": e} for e in (32, 64, 128)]),
+        # same exported file, searched by the native C++ HNSW engine
+        # (cpp/src/hnsw.cc) — host-CPU graph search, threaded over queries
+        ("hnsw_native", {"graph_degree": 32}, [{"ef": e} for e in (32, 64, 128)]),
     ]
     if ds.metric != "inner_product":
         # external-library comparator: sklearn spatial trees (L2/cosine

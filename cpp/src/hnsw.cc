@@ -1,0 +1,420 @@
+// Native hnswlib-format index: independent parser + true HNSW search.
+//
+// Role (ref: cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h + the interop of
+// neighbors/hnsw.hpp): the reference links the real hnswlib to (a) search
+// CAGRA indexes exported to hnswlib's format on CPU and (b) act as a bench
+// comparator.  hnswlib cannot be installed in this environment, so this
+// file plays that role natively: it re-implements, from the published
+// algorithm (Malkov & Yashunin, arXiv:1603.09320) and hnswlib's documented
+// binary layout, a from-scratch reader + hierarchical best-first searcher.
+// Because the parser and search share NOTHING with the Python writer
+// (raft_tpu/neighbors/hnsw.py) — different language, different field
+// arithmetic, a different traversal algorithm — agreement between the two
+// is a real cross-validation of the binary format, not a self-check.
+//
+// Layout parsed (hnswlib hnswalg.h saveIndex order):
+//   u64 offset_level0, u64 max_elements, u64 cur_count, u64 size_per_el,
+//   u64 label_offset, u64 offset_data, i32 max_level, i32 entrypoint,
+//   u64 max_M, u64 max_M0, u64 M, f64 mult, u64 ef_construction,
+//   cur_count * size_per_el bytes of level-0 memory
+//     (per element: [u16 count + u16 flags][maxM0 x u32 links]
+//                   [dim x f32 vector][u64 label]),
+//   then per element: u32 link_list_bytes, followed by that many bytes of
+//   upper-level links ([u16 count + u16 flags][maxM x u32]) per level.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <limits>
+#include <memory>
+#include <queue>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_hnsw_error;
+
+int fail_hnsw(const std::exception& e) {
+  g_hnsw_error = e.what();
+  return 1;
+}
+
+// metric codes shared with raft_tpu/core/native.py (same enum as
+// algorithms.cc; duplicated locally to keep each TU self-contained)
+enum class metric_code : int {
+  sqeuclidean = 0,
+  euclidean = 1,
+  inner_product = 2,
+  cosine = 3,
+};
+
+// One upper level's links: packed [members, max_m] rows (-1 padded) plus a
+// member → row map. Level l holds only ~n/M^l members, so packing the rows
+// keeps per-level memory ~n/M^l * max_m instead of a dense n * max_m table;
+// row_of costs 4 B/element/level (hnswlib's own linkLists_ pointer array is
+// 8 B/element).
+struct level_table {
+  std::vector<std::int32_t> row_of;  // [n], -1 when not a member
+  std::vector<std::int32_t> links;   // [members, max_m], -1 padded
+};
+
+struct hnsw_index {
+  std::int64_t n = 0;
+  std::int64_t dim = 0;
+  std::int64_t max_m = 0;    // upper-level degree cap
+  std::int64_t max_m0 = 0;   // level-0 degree cap
+  std::int32_t max_level = 0;
+  std::int32_t entrypoint = 0;
+  std::vector<float> data;          // [n, dim]
+  std::vector<std::int32_t> links0;  // [n, max_m0], -1 padded
+  std::vector<std::int32_t> count0;  // [n]
+  std::vector<std::int64_t> labels;  // [n]
+  std::vector<std::int32_t> levels;  // [n] element's top level
+  std::vector<level_table> upper;    // level l in 1..max_level at [l-1]
+
+  const float* vec(std::int64_t i) const { return data.data() + i * dim; }
+};
+
+template <typename T>
+T read_pod(std::FILE* fh, const char* what) {
+  T v;
+  if (std::fread(&v, sizeof(T), 1, fh) != 1)
+    throw std::runtime_error(std::string("hnsw: truncated file reading ") + what);
+  return v;
+}
+
+float dist(const hnsw_index& ix, const float* q, float q2, float qnorm,
+           std::int64_t id, metric_code metric) {
+  const float* rv = ix.vec(id);
+  float ip = 0.f, rn2 = 0.f;
+  for (std::int64_t j = 0; j < ix.dim; ++j) {
+    ip += q[j] * rv[j];
+    rn2 += rv[j] * rv[j];
+  }
+  switch (metric) {
+    case metric_code::inner_product:
+      return -ip;
+    case metric_code::cosine:
+      return 1.f - ip / (qnorm * std::max(std::sqrt(rn2), 1e-12f));
+    case metric_code::euclidean:
+      return std::sqrt(std::max(q2 + rn2 - 2.f * ip, 0.f));
+    default:
+      return std::max(q2 + rn2 - 2.f * ip, 0.f);
+  }
+}
+
+// Greedy 1-NN descent on one upper level (algorithm 2 of the paper with
+// ef=1): repeatedly move to the closest neighbor until no link improves.
+std::int32_t greedy_level(const hnsw_index& ix, const float* q, float q2,
+                          float qnorm, std::int32_t start, int level,
+                          metric_code metric) {
+  const level_table& tab = ix.upper[level - 1];
+  std::int32_t cur = start;
+  float cur_d = dist(ix, q, q2, qnorm, cur, metric);
+  bool improved = true;
+  while (improved) {
+    improved = false;
+    std::int32_t r = tab.row_of[cur];
+    if (r < 0) break;  // current node carries no links at this level
+    const std::int32_t* row = tab.links.data() + static_cast<std::int64_t>(r) * ix.max_m;
+    for (std::int64_t j = 0; j < ix.max_m; ++j) {
+      std::int32_t nb = row[j];
+      if (nb < 0) break;  // -1 padded tail
+      float d = dist(ix, q, q2, qnorm, nb, metric);
+      if (d < cur_d) {
+        cur_d = d;
+        cur = nb;
+        improved = true;
+      }
+    }
+  }
+  return cur;
+}
+
+// Best-first layer-0 search (algorithm 2): candidates min-heap, results
+// max-heap bounded at ef, visited epoch tags so the scratch array is
+// cleared O(1) per query.
+void search_layer0(const hnsw_index& ix, const float* q, float q2, float qnorm,
+                   std::int32_t entry, std::int64_t ef, metric_code metric,
+                   std::vector<std::uint32_t>& visited, std::uint32_t epoch,
+                   std::vector<std::pair<float, std::int32_t>>& out) {
+  using pf = std::pair<float, std::int32_t>;
+  std::priority_queue<pf, std::vector<pf>, std::greater<pf>> cand;  // min
+  std::priority_queue<pf> found;                                    // max
+  float d0 = dist(ix, q, q2, qnorm, entry, metric);
+  cand.emplace(d0, entry);
+  found.emplace(d0, entry);
+  visited[entry] = epoch;
+  while (!cand.empty()) {
+    auto [cd, cid] = cand.top();
+    if (cd > found.top().first && static_cast<std::int64_t>(found.size()) >= ef)
+      break;
+    cand.pop();
+    const std::int32_t* row =
+        ix.links0.data() + static_cast<std::int64_t>(cid) * ix.max_m0;
+    std::int32_t cnt = ix.count0[cid];
+    for (std::int32_t j = 0; j < cnt; ++j) {
+      std::int32_t nb = row[j];
+      if (nb < 0 || visited[nb] == epoch) continue;
+      visited[nb] = epoch;
+      float d = dist(ix, q, q2, qnorm, nb, metric);
+      if (static_cast<std::int64_t>(found.size()) < ef || d < found.top().first) {
+        cand.emplace(d, nb);
+        found.emplace(d, nb);
+        if (static_cast<std::int64_t>(found.size()) > ef) found.pop();
+      }
+    }
+  }
+  out.clear();
+  while (!found.empty()) {
+    out.push_back(found.top());
+    found.pop();
+  }
+  std::reverse(out.begin(), out.end());  // ascending distance
+}
+
+void search_rows(const hnsw_index& ix, const float* queries, std::int64_t k,
+                 std::int64_t ef, metric_code metric, float* out_d,
+                 std::int64_t* out_i, std::int64_t q_begin, std::int64_t q_end,
+                 std::vector<std::uint32_t>& visited,
+                 std::vector<std::pair<float, std::int32_t>>& scratch) {
+  for (std::int64_t qi = q_begin; qi < q_end; ++qi) {
+    const float* q = queries + qi * ix.dim;
+    float q2 = 0.f;
+    for (std::int64_t j = 0; j < ix.dim; ++j) q2 += q[j] * q[j];
+    const float qnorm = std::max(std::sqrt(q2), 1e-12f);
+    std::int32_t cur = ix.entrypoint;
+    for (int level = ix.max_level; level >= 1; --level)
+      cur = greedy_level(ix, q, q2, qnorm, cur, level, metric);
+    // epoch = query index + 1 (0 is "never visited"); wraps are impossible
+    // within one call since epochs only grow
+    search_layer0(ix, q, q2, qnorm, cur, std::max(ef, k), metric, visited,
+                  static_cast<std::uint32_t>(qi + 1), scratch);
+    for (std::int64_t j = 0; j < k; ++j) {
+      if (j < static_cast<std::int64_t>(scratch.size())) {
+        float v = scratch[j].first;
+        out_d[qi * k + j] =
+            metric == metric_code::inner_product ? -v : v;
+        out_i[qi * k + j] = ix.labels[scratch[j].second];
+      } else {  // fewer than k reachable (tiny/disconnected graphs)
+        out_d[qi * k + j] = metric == metric_code::inner_product
+                                ? -std::numeric_limits<float>::infinity()
+                                : std::numeric_limits<float>::infinity();
+        out_i[qi * k + j] = -1;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* rt_hnsw_last_error() { return g_hnsw_error.c_str(); }
+
+// Parse an hnswlib index file. dim must be supplied (hnswlib stores it in
+// the space, not the file — same contract as hnswlib.Index(space, dim)).
+// Returns an opaque handle through *out_handle.
+int rt_hnsw_load(const char* path, std::int64_t dim, void** out_handle) {
+  std::FILE* fh = nullptr;
+  try {
+    fh = std::fopen(path, "rb");
+    if (!fh) throw std::runtime_error(std::string("hnsw: cannot open ") + path);
+    auto ix = std::make_unique<hnsw_index>();
+    ix->dim = dim;
+    read_pod<std::uint64_t>(fh, "offset_level0");
+    std::uint64_t max_el = read_pod<std::uint64_t>(fh, "max_elements");
+    std::uint64_t n = read_pod<std::uint64_t>(fh, "cur_count");
+    std::uint64_t size_per = read_pod<std::uint64_t>(fh, "size_per_el");
+    std::uint64_t label_off = read_pod<std::uint64_t>(fh, "label_offset");
+    std::uint64_t offset_data = read_pod<std::uint64_t>(fh, "offset_data");
+    ix->max_level = read_pod<std::int32_t>(fh, "max_level");
+    ix->entrypoint = read_pod<std::int32_t>(fh, "entrypoint");
+    std::uint64_t max_m = read_pod<std::uint64_t>(fh, "max_M");
+    std::uint64_t max_m0 = read_pod<std::uint64_t>(fh, "max_M0");
+    read_pod<std::uint64_t>(fh, "M");
+    read_pod<double>(fh, "mult");
+    read_pod<std::uint64_t>(fh, "ef_construction");
+    if (n > max_el)
+      throw std::runtime_error("hnsw: cur_count exceeds max_elements");
+    // geometry check: the level-0 element must be exactly
+    // [u32 count][max_m0 links][dim f32][u64 label]
+    if (offset_data != 4 + max_m0 * 4)
+      throw std::runtime_error("hnsw: offset_data inconsistent with max_M0");
+    if (label_off != offset_data + static_cast<std::uint64_t>(dim) * 4 ||
+        size_per != label_off + 8)
+      throw std::runtime_error(
+          "hnsw: element size inconsistent with dim (wrong dim for this file?)");
+    ix->n = static_cast<std::int64_t>(n);
+    ix->max_m = static_cast<std::int64_t>(max_m);
+    ix->max_m0 = static_cast<std::int64_t>(max_m0);
+    ix->data.resize(ix->n * ix->dim);
+    ix->links0.assign(ix->n * ix->max_m0, -1);
+    ix->count0.resize(ix->n);
+    ix->labels.resize(ix->n);
+    ix->levels.assign(ix->n, 0);
+    std::vector<std::uint8_t> el(size_per);
+    for (std::int64_t i = 0; i < ix->n; ++i) {
+      if (std::fread(el.data(), 1, size_per, fh) != size_per)
+        throw std::runtime_error("hnsw: truncated level-0 block");
+      // link count is u16; the upper half-word carries delete flags
+      std::uint16_t cnt;
+      std::memcpy(&cnt, el.data(), 2);
+      if (cnt > max_m0) throw std::runtime_error("hnsw: link count > max_M0");
+      ix->count0[i] = cnt;
+      std::memcpy(ix->links0.data() + i * ix->max_m0, el.data() + 4, cnt * 4);
+      std::memcpy(ix->data.data() + i * ix->dim, el.data() + offset_data,
+                  ix->dim * 4);
+      std::memcpy(&ix->labels[i], el.data() + label_off, 8);
+    }
+    // upper levels: hnswlib writes, per element, u32 byte-count then the
+    // element's concatenated per-level link blocks
+    const std::uint64_t per_level = 4 + max_m * 4;  // u32 count + maxM links
+    ix->upper.assign(std::max(ix->max_level, 0), level_table{});
+    for (auto& t : ix->upper) t.row_of.assign(ix->n, -1);
+    std::vector<std::uint8_t> buf;
+    for (std::int64_t i = 0; i < ix->n; ++i) {
+      std::uint32_t nbytes = read_pod<std::uint32_t>(fh, "link_list_size");
+      if (nbytes == 0) continue;
+      if (per_level == 0 || nbytes % per_level)
+        throw std::runtime_error("hnsw: upper link list size not a multiple "
+                                 "of the per-level block");
+      std::int64_t lv = static_cast<std::int64_t>(nbytes / per_level);
+      if (lv > ix->max_level)
+        throw std::runtime_error("hnsw: element level exceeds max_level");
+      ix->levels[i] = static_cast<std::int32_t>(lv);
+      buf.resize(nbytes);
+      if (std::fread(buf.data(), 1, nbytes, fh) != nbytes)
+        throw std::runtime_error("hnsw: truncated upper link lists");
+      for (std::int64_t l = 1; l <= lv; ++l) {
+        const std::uint8_t* blk = buf.data() + (l - 1) * per_level;
+        std::uint16_t cnt;
+        std::memcpy(&cnt, blk, 2);
+        if (cnt > max_m)
+          throw std::runtime_error("hnsw: upper link count > max_M");
+        level_table& t = ix->upper[l - 1];
+        t.row_of[i] =
+            static_cast<std::int32_t>(t.links.size() / std::max<std::int64_t>(ix->max_m, 1));
+        std::size_t base = t.links.size();
+        t.links.resize(base + ix->max_m, -1);
+        for (std::uint16_t j = 0; j < cnt; ++j) {
+          std::int32_t id;
+          std::memcpy(&id, blk + 4 + j * 4, 4);
+          // validate like level-0 links: a corrupt upper id must fail the
+          // load, not fault the first search's greedy descent
+          if (id < 0 || id >= ix->n)
+            throw std::runtime_error("hnsw: upper link out of range");
+          t.links[base + j] = id;
+        }
+      }
+    }
+    for (std::int64_t i = 0; i < ix->n; ++i) {
+      std::int32_t cnt = ix->count0[i];
+      const std::int32_t* row = ix->links0.data() + i * ix->max_m0;
+      for (std::int32_t j = 0; j < cnt; ++j)
+        if (row[j] < 0 || row[j] >= ix->n)
+          throw std::runtime_error("hnsw: level-0 link out of range");
+    }
+    if (ix->entrypoint < 0 || ix->entrypoint >= ix->n)
+      throw std::runtime_error("hnsw: entrypoint out of range");
+    std::fclose(fh);
+    *out_handle = ix.release();
+    return 0;
+  } catch (const std::exception& e) {
+    if (fh) std::fclose(fh);
+    return fail_hnsw(e);
+  }
+}
+
+// Field introspection for cross-validation against other parsers.
+int rt_hnsw_info(void* handle, std::int64_t* out_n, std::int64_t* out_dim,
+                 std::int64_t* out_max_m0, std::int32_t* out_max_level,
+                 std::int32_t* out_entrypoint) {
+  auto* ix = static_cast<hnsw_index*>(handle);
+  if (!ix) return 1;
+  *out_n = ix->n;
+  *out_dim = ix->dim;
+  *out_max_m0 = ix->max_m0;
+  *out_max_level = ix->max_level;
+  *out_entrypoint = ix->entrypoint;
+  return 0;
+}
+
+// Copy out element i's vector + label + level-0 links (for byte-level
+// cross-checks); links buffer must hold max_m0 entries, -1 padded.
+int rt_hnsw_element(void* handle, std::int64_t i, float* out_vec,
+                    std::int64_t* out_label, std::int32_t* out_links) {
+  try {
+    auto* ix = static_cast<hnsw_index*>(handle);
+    if (!ix || i < 0 || i >= ix->n)
+      throw std::runtime_error("hnsw: element index out of range");
+    std::memcpy(out_vec, ix->vec(i), ix->dim * 4);
+    *out_label = ix->labels[i];
+    std::memcpy(out_links, ix->links0.data() + i * ix->max_m0, ix->max_m0 * 4);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_hnsw(e);
+  }
+}
+
+// True HNSW search: greedy upper-level descent, ef-bounded best-first at
+// level 0.  Threaded over queries (same pattern as rt_refine_host).
+// Returned ids are the stored labels, like hnswlib's knn_query.
+int rt_hnsw_search(void* handle, const float* queries, std::int64_t n_q,
+                   std::int64_t k, std::int64_t ef, int metric, float* out_d,
+                   std::int64_t* out_i, std::int64_t n_threads) {
+  try {
+    auto* ix = static_cast<hnsw_index*>(handle);
+    if (!ix) throw std::runtime_error("hnsw: null handle");
+    if (k <= 0 || n_q < 0) throw std::runtime_error("hnsw: bad k or n_q");
+    metric_code mc = static_cast<metric_code>(metric);
+    std::int64_t nt = std::max<std::int64_t>(
+        1, std::min<std::int64_t>(
+               n_threads > 0 ? n_threads : std::thread::hardware_concurrency(),
+               n_q));
+    // per-thread visited tags + scratch preallocated by the spawner; the
+    // priority queues inside search_layer0 still allocate per push, so
+    // each worker runs under its own catch — an escaped bad_alloc on a
+    // std::thread would bypass this function's try/catch and
+    // std::terminate the process
+    std::vector<std::vector<std::uint32_t>> visited(nt);
+    std::vector<std::vector<std::pair<float, std::int32_t>>> scratch(nt);
+    std::vector<std::string> errors(nt);
+    for (std::int64_t t = 0; t < nt; ++t) {
+      visited[t].assign(ix->n, 0);
+      scratch[t].reserve(std::max(ef, k) + 1);
+    }
+    std::vector<std::thread> threads;
+    std::int64_t per = (n_q + nt - 1) / nt;
+    for (std::int64_t t = 0; t < nt; ++t) {
+      std::int64_t b = t * per, e = std::min(n_q, b + per);
+      if (b >= e) break;
+      threads.emplace_back([&, t, b, e] {
+        try {
+          search_rows(*ix, queries, k, ef, mc, out_d, out_i, b, e, visited[t],
+                      scratch[t]);
+        } catch (const std::exception& ex) {
+          errors[t] = ex.what();
+        } catch (...) {
+          errors[t] = "hnsw: unknown error in search worker";
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+    for (auto& err : errors)
+      if (!err.empty()) throw std::runtime_error(err);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail_hnsw(e);
+  }
+}
+
+void rt_hnsw_free(void* handle) { delete static_cast<hnsw_index*>(handle); }
+
+}  // extern "C"

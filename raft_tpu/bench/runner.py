@@ -326,7 +326,9 @@ class HnswANN(ANN):
 
     name = "hnswlib_format"
 
-    def build(self, dataset):
+    def _export(self, dataset):
+        """Build the CAGRA graph and write the hnswlib interchange file —
+        the part shared by every engine that searches the exported file."""
         import tempfile
 
         from raft_tpu.neighbors import cagra, hnsw
@@ -344,6 +346,10 @@ class HnswANN(ANN):
         fd, self._path = tempfile.mkstemp(suffix=".hnsw")
         os.close(fd)
         hnsw.serialize_to_hnswlib(self._path, built)
+
+    def build(self, dataset):
+        self._export(dataset)
+        hnsw = self._hnsw
         try:  # real hnswlib when available; its absence is the only silent
             # fallback — a broken load of a present hnswlib must surface,
             # not quietly benchmark the wrong engine under this label
@@ -384,11 +390,45 @@ class HnswANN(ANN):
         shutil.copy(self._path, path)
 
 
+class HnswNativeANN(HnswANN):
+    """Native-engine variant of ``hnswlib_format``: the exported file is
+    searched by the from-scratch C++ HNSW engine (cpp/src/hnsw.cc — greedy
+    upper-level descent + ef-bounded best-first, threaded over queries),
+    the same role hnswlib's C++ plays in the reference's harness
+    (cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h). Pure host CPU — no JAX
+    in the search path — so it is a genuinely separate codepath from every
+    raft_tpu_* algorithm."""
+
+    name = "hnsw_native"
+
+    def build(self, dataset):
+        self._export(dataset)  # graph + interchange file only — no beam/
+        # hnswlib engine load whose work this class would discard
+        from raft_tpu.neighbors import hnsw
+
+        self._lib_index = None
+        self._native = hnsw.load_native(self._path, self._dim)
+        self._threads = 0
+        self._ef = 64
+
+    def set_search_param(self, param):
+        super().set_search_param(param)
+        self._threads = int(param.get("n_threads", 0))
+
+    def search(self, queries, k):
+        d, ids = self._native.search(
+            np.asarray(queries, np.float32), k, ef=self._ef,
+            metric=self.metric, n_threads=self._threads,
+        )
+        return d, ids.astype(np.int32)
+
+
 ALGORITHMS = {
     a.name: a
     for a in (
         BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, CagraVpqANN,
         CagraBf16ANN, BallCoverANN, NumpyExactANN, SklearnANN, HnswANN,
+        HnswNativeANN,
     )
 }
 

@@ -162,6 +162,32 @@ def _load():
             ctypes.c_float, ctypes.c_float, ctypes.c_float,
             ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        # native hnswlib-format engine (ref: the hnswlib role of
+        # cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h)
+        lib.rt_hnsw_last_error.restype = ctypes.c_char_p
+        lib.rt_hnsw_load.restype = ctypes.c_int
+        lib.rt_hnsw_load.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.rt_hnsw_info.restype = ctypes.c_int
+        lib.rt_hnsw_info.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.rt_hnsw_element.restype = ctypes.c_int
+        lib.rt_hnsw_element.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+        ]
+        lib.rt_hnsw_search.restype = ctypes.c_int
+        lib.rt_hnsw_search.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.rt_hnsw_free.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return _LIB
 
@@ -462,6 +488,93 @@ def rmat_host(
     if code != 0:
         raise RuntimeError(_lib().rt_alg_last_error().decode())
     return rows, cols
+
+
+class HnswNativeIndex:
+    """Native hnswlib-format index: independent C++ parser + true
+    hierarchical HNSW search (ref: the hnswlib dependency's role in
+    neighbors/hnsw.hpp and cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h).
+
+    Shares no code with the Python writer/parser in
+    ``raft_tpu/neighbors/hnsw.py`` — loading a file written there through
+    this class is a cross-language validation of the binary format.
+    """
+
+    def __init__(self, path: str, dim: int):
+        self._h = None
+        h = ctypes.c_void_p()
+        code = _lib().rt_hnsw_load(
+            os.fsencode(path), int(dim), ctypes.byref(h)
+        )
+        if code != 0:
+            raise RuntimeError(_lib().rt_hnsw_last_error().decode())
+        self._h = h
+        self.dim = int(dim)
+
+    @property
+    def info(self) -> dict:
+        n = ctypes.c_int64()
+        dim = ctypes.c_int64()
+        max_m0 = ctypes.c_int64()
+        max_level = ctypes.c_int32()
+        entry = ctypes.c_int32()
+        code = _lib().rt_hnsw_info(
+            self._h, ctypes.byref(n), ctypes.byref(dim), ctypes.byref(max_m0),
+            ctypes.byref(max_level), ctypes.byref(entry),
+        )
+        if code != 0:
+            raise RuntimeError(_lib().rt_hnsw_last_error().decode())
+        return {
+            "n": n.value, "dim": dim.value, "max_m0": max_m0.value,
+            "max_level": max_level.value, "entrypoint": entry.value,
+        }
+
+    def element(self, i: int):
+        """(vector [dim] f32, label int, level-0 links [max_m0] i32,
+        -1 padded) — the cross-check surface for other parsers."""
+        inf = self.info
+        vec = np.empty(inf["dim"], np.float32)
+        links = np.empty(inf["max_m0"], np.int32)
+        label = ctypes.c_int64()
+        code = _lib().rt_hnsw_element(
+            self._h, int(i), vec.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(label), links.ctypes.data_as(ctypes.c_void_p),
+        )
+        if code != 0:
+            raise RuntimeError(_lib().rt_hnsw_last_error().decode())
+        return vec, int(label.value), links
+
+    def search(
+        self, queries: np.ndarray, k: int, ef: int = 64,
+        metric: str = "sqeuclidean", n_threads: int = 0,
+    ):
+        """hnswlib-semantics knn_query: greedy upper-level descent then
+        ef-bounded best-first at layer 0. Returns (distances [q, k] f32,
+        labels [q, k] i64)."""
+        if metric not in _METRIC_CODES:
+            raise ValueError(f"unsupported hnsw metric {metric!r}")
+        queries = np.ascontiguousarray(queries, np.float32)
+        if queries.ndim != 2 or queries.shape[1] != self.dim:
+            raise ValueError(f"queries must be [q, {self.dim}]")
+        n_q = queries.shape[0]
+        out_d = np.empty((n_q, k), np.float32)
+        out_i = np.empty((n_q, k), np.int64)
+        code = _lib().rt_hnsw_search(
+            self._h, queries.ctypes.data_as(ctypes.c_void_p), n_q, int(k),
+            int(ef), _METRIC_CODES[metric],
+            out_d.ctypes.data_as(ctypes.c_void_p),
+            out_i.ctypes.data_as(ctypes.c_void_p), n_threads,
+        )
+        if code != 0:
+            raise RuntimeError(_lib().rt_hnsw_last_error().decode())
+        return out_d, out_i
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            try:
+                _lib().rt_hnsw_free(self._h)
+            except Exception:
+                pass
 
 
 class InterruptibleToken:

@@ -12,6 +12,15 @@ The exported file loads in stock hnswlib (`hnswlib.Index(space='l2', dim=d)
 .load_index(path)`). Since hnswlib is not bundled in this environment, the
 module also parses the format back and searches it with the CAGRA beam
 engine — the capability the reference gets from its hnswlib dependency.
+
+Two independent engines can read the files this module writes:
+
+* :func:`load` — the Python parser here, searched with the CAGRA beam.
+* :func:`load_native` — the from-scratch C++ parser + true hierarchical
+  HNSW search in ``cpp/src/hnsw.cc`` (greedy upper-level descent +
+  ef-bounded best-first, the hnswlib algorithm re-implemented from the
+  paper). It shares nothing with the writer, so agreement between the two
+  is a cross-language validation of the binary layout.
 """
 
 from __future__ import annotations
@@ -28,14 +37,80 @@ from raft_tpu.neighbors import cagra
 from raft_tpu.core.trace import traced
 
 
-def serialize_to_hnswlib(filename: str, index: "cagra.Index") -> None:
-    """Write a CAGRA index as an hnswlib level-0-only index file
-    (ref: cagra_serialize.cuh serialize_to_hnswlib)."""
+def _build_hierarchy(data: np.ndarray, max_m: int, seed: int):
+    """Geometric level assignment + per-level kNN links — the upper layers
+    a real HNSW carries (Malkov & Yashunin §4: P(level ≥ l) = M^-l, each
+    layer a kNN graph over its members).
+
+    The reference's exporter writes NO upper levels
+    (cagra_serialize.cuh:196-202 emits one zero int per element), so a
+    single-entry search over its files has no long-range hops and fails on
+    strongly clustered data. Building the hierarchy at export time fixes
+    that for every consumer — stock hnswlib included. Levels draw from a
+    fixed-seed RNG so exports are reproducible.
+
+    Returns (levels [n] int64, {level: (member_ids, links [m, ≤max_m])}).
+    """
+    from raft_tpu.neighbors import brute_force
+
+    n = data.shape[0]
+    mult = 1.0 / np.log(max(max_m, 2))
+    rng = np.random.default_rng(seed)
+    u = rng.random(n)
+    levels = np.floor(-np.log(np.maximum(u, 1e-300)) * mult).astype(np.int64)
+    # cap at ~log_M(n): deeper draws add empty layers, not navigability
+    cap = max(1, int(np.log(max(n, 2)) * mult) + 1)
+    levels = np.minimum(levels, cap)
+    upper = {}
+    for lvl in range(1, int(levels.max()) + 1):
+        members = np.flatnonzero(levels >= lvl)
+        k_l = min(max_m, len(members) - 1)
+        if k_l <= 0:
+            upper[lvl] = (members, np.zeros((len(members), 0), np.uint32))
+            continue
+        sub = data[members]
+        # self lands at rank 0 (distance 0); request one extra and drop it.
+        # brute_force.knn tiles device-side, so the per-level cost is the
+        # exact-kNN of the ~n/M^l member subset, not an n x n scan.
+        _, nb = brute_force.knn(sub, sub, k_l + 1)
+        nb = np.asarray(nb).astype(np.int64)
+        # drop self per row, vectorized: stable-sort self slots last, keep
+        # the first k_l (original neighbor order preserved for the rest)
+        is_self = nb == np.arange(len(members))[:, None]
+        order = np.argsort(is_self, axis=1, kind="stable")
+        keep = np.take_along_axis(nb, order, 1)[:, :k_l]
+        upper[lvl] = (members, members[keep].astype(np.uint32))
+    return levels, upper
+
+
+def serialize_to_hnswlib(
+    filename: str, index: "cagra.Index", *, hierarchy: bool = True,
+    seed: int = 0,
+) -> None:
+    """Write a CAGRA index as an hnswlib index file
+    (ref: cagra_serialize.cuh serialize_to_hnswlib:96-203).
+
+    With ``hierarchy=True`` (default) real upper HNSW layers are built at
+    export (see :func:`_build_hierarchy`) so single-entry hierarchical
+    searchers — stock hnswlib, :func:`load_native` — navigate clustered
+    data; ``hierarchy=False`` reproduces the reference exporter's
+    level-0-only layout byte for byte."""
     data = np.asarray(index.dataset, np.float32)
     graph = np.asarray(index.graph, np.uint32)
     n, dim = data.shape
     deg = graph.shape[1]
+    max_m = deg // 2
+    if hierarchy:
+        levels, upper = _build_hierarchy(data, max_m, seed)
+        max_level = int(levels.max())
+        entrypoint = int(np.argmax(levels))
+    else:
+        levels = np.zeros(n, np.int64)
+        upper = {}
+        max_level = 1
+        entrypoint = n // 2
     size_data_per_element = deg * 4 + 4 + dim * 4 + 8
+    per_level = 4 + max_m * 4  # [u32 count][max_M links] per upper level
     with open(filename, "wb") as fh:
         fh.write(struct.pack("<Q", 0))                        # offset_level_0
         fh.write(struct.pack("<Q", n))                        # max_element
@@ -43,12 +118,12 @@ def serialize_to_hnswlib(filename: str, index: "cagra.Index") -> None:
         fh.write(struct.pack("<Q", size_data_per_element))
         fh.write(struct.pack("<Q", size_data_per_element - 8))  # label_offset
         fh.write(struct.pack("<Q", deg * 4 + 4))              # offset_data
-        fh.write(struct.pack("<i", 1))                        # max_level
-        fh.write(struct.pack("<i", n // 2))                   # entrypoint_node
-        fh.write(struct.pack("<Q", deg // 2))                 # max_M
+        fh.write(struct.pack("<i", max_level))
+        fh.write(struct.pack("<i", entrypoint))
+        fh.write(struct.pack("<Q", max_m))                    # max_M
         fh.write(struct.pack("<Q", deg))                      # max_M0
-        fh.write(struct.pack("<Q", deg // 2))                 # M
-        fh.write(struct.pack("<d", 0.42424242))               # mult (unused)
+        fh.write(struct.pack("<Q", max_m))                    # M
+        fh.write(struct.pack("<d", 1.0 / np.log(max(max_m, 2))))  # mult
         fh.write(struct.pack("<Q", 500))                      # ef_construction
         # level-0 memory: one element at a time
         block = np.zeros(size_data_per_element, np.uint8)
@@ -61,8 +136,26 @@ def serialize_to_hnswlib(filename: str, index: "cagra.Index") -> None:
             off += dim * 4
             block[off : off + 8] = np.frombuffer(struct.pack("<Q", i), np.uint8)
             fh.write(block.tobytes())
-        # upper-level link lists: all absent
-        fh.write(np.zeros(n, np.int32).tobytes())
+        # upper-level link lists: per element, u32 byte count then one
+        # [u32 count][max_M links (zero padded)] block per level it reaches
+        if not hierarchy:
+            fh.write(np.zeros(n, np.int32).tobytes())
+            return
+        # member id → row in its level's link table, per level
+        pos = {
+            lvl: {int(m): r for r, m in enumerate(mem)}
+            for lvl, (mem, _) in upper.items()
+        }
+        for i in range(n):
+            lv = int(levels[i])
+            fh.write(struct.pack("<I", lv * per_level))
+            for lvl in range(1, lv + 1):
+                mem, links = upper[lvl]
+                row = links[pos[lvl][i]]
+                fh.write(struct.pack("<I", len(row)))
+                padded = np.zeros(max_m, np.uint32)
+                padded[: len(row)] = row
+                fh.write(padded.tobytes())
 
 
 def load(filename: str, dim: int, *, metric: str = "sqeuclidean") -> "cagra.Index":
@@ -119,3 +212,17 @@ def search(
     width (ref: hnsw.hpp search_params{ef})."""
     params = cagra.SearchParams(itopk_size=max(ef, k))
     return cagra.search(params, index, queries, k, res=res)
+
+
+def load_native(filename: str, dim: int):
+    """Load an hnswlib index file into the native C++ engine
+    (ref: the hnswlib dependency's role in hnsw.hpp — CPU search over the
+    exported graph). Returns a handle with ``.search(queries, k, ef=,
+    metric=)`` → (distances, labels) and ``.info`` / ``.element(i)`` for
+    format introspection. Raises RuntimeError if the native toolchain is
+    unavailable or the file is inconsistent with ``dim``."""
+    from raft_tpu.core import native
+
+    if not native.available():
+        raise RuntimeError("native core unavailable (no toolchain?)")
+    return native.HnswNativeIndex(filename, dim)
