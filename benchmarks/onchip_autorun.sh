@@ -109,6 +109,14 @@ for attempt in $(seq 1 400); do
     "On-chip prims sweep: select_k + ivf_scan A/B data" \
     python -m raft_tpu.bench.prims --out "$B/prims_tpu.json"
 
+  # derived artifact: fitted heuristic constants from the sweep above
+  # (pure host post-processing — no tunnel needed once prims_tpu exists)
+  if [ -s "$B/prims_tpu.json" ]; then
+    run_item "$B/fit_heuristics_tpu.json" 300 \
+      "Heuristic fit from the on-chip prims sweep" \
+      bash -c "python $B/fit_heuristics.py $B/prims_tpu.json > $B/fit_heuristics_tpu.json"
+  fi
+
   if [ -s "$B/ladder_tpu.json" ] && [ -s "$B/frontier_tpu.json" ] \
      && [ -s "$B/scale_build_tpu_n10000000.json" ] \
      && [ -s "$B/ab_scan_dtype_tpu.jsonl" ] && [ -s "$B/prims_tpu.json" ]; then
