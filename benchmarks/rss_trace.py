@@ -85,19 +85,33 @@ def main() -> None:
     import raft_tpu.cluster.kmeans_balanced as kb
     import raft_tpu.neighbors.ivf_pq as ipq
 
+    calls: dict = {}
+
     def tag(mod, name, label):
         orig = getattr(mod, name)
 
         def wrapper(*a, **k):
             prev = smp.phase
             smp.phase = label
-            print(f"[{time.strftime('%H:%M:%S')}] -> {label} rss={rss_gb():.2f}",
-                  flush=True)
-            try:
-                return orig(*a, **k)
-            finally:
-                print(f"[{time.strftime('%H:%M:%S')}] <- {label} rss={rss_gb():.2f}",
+            c = calls[label] = calls.get(label, 0) + 1
+            if c <= 3:  # chatty phases (per-tile encode) log only at first
+                print(f"[{time.strftime('%H:%M:%S')}] -> {label} rss={rss_gb():.2f}",
                       flush=True)
+            try:
+                out = orig(*a, **k)
+                # block so async device work is charged to THIS phase, not
+                # wherever the Python thread happens to be when it drains
+                import jax as _jax
+
+                try:
+                    _jax.block_until_ready(out)
+                except Exception:
+                    pass
+                return out
+            finally:
+                if c <= 3:
+                    print(f"[{time.strftime('%H:%M:%S')}] <- {label} rss={rss_gb():.2f}",
+                          flush=True)
                 smp.phase = prev
 
         setattr(mod, name, wrapper)
@@ -106,8 +120,9 @@ def main() -> None:
         (kb, "fit", "kmeans_fit"),
         (kb, "predict", "kmeans_predict"),
         (ipq, "_train_codebooks_lloyd", "codebook_train"),
-        (ipq, "_encode_rows", "encode") if hasattr(ipq, "_encode_rows") else (None, None, None),
-        (ipq, "_assemble_streamed", "assemble") if hasattr(ipq, "_assemble_streamed") else (None, None, None),
+        (ipq, "_encode", "encode"),
+        (ipq, "_decode_rows", "decode_rows"),
+        (ipq, "_extend_encoded", "extend_encoded"),
     ]:
         if mod is not None and hasattr(mod, fn):
             tag(mod, fn, label)

@@ -123,13 +123,21 @@ def _balanced_iterations(
         if spherical:
             centers = _maybe_normalize(centers, "cosine")
         # --- adjust: teleport starved clusters onto random data points,
-        # sampled ∝ weight so weight-0 padding rows are never chosen
+        # uniform over positive-weight rows (weight-0 padding never chosen).
+        # Inverse-CDF draw, NOT jax.random.categorical: categorical over n
+        # logits with shape=(n_clusters,) materializes an [n_clusters, n]
+        # gumbel tensor — ~1 GB/iteration at a 250k trainset and ~50 GB at
+        # DEEP-scale (measured via compile memory_analysis; it was the
+        # build pipeline's peak-memory term)
         total = jnp.sum(weights)
         avg = total / n_clusters
         starved = counts < avg / 8.0  # ref threshold: average/adjust ratio
-        picks = jax.random.categorical(
-            key_i, jnp.where(weights > 0, 0.0, -jnp.inf), shape=(n_clusters,)
-        )
+        # int32 cumsum: an f32 running sum silently plateaus at 2^24 rows,
+        # which would starve everything past ~16.7M of selection probability
+        cum = jnp.cumsum((weights > 0).astype(jnp.int32))
+        r = jax.random.randint(key_i, (n_clusters,), 1, cum[-1] + 1)
+        # first idx with cum[idx] >= r: zero-weight rows own empty intervals
+        picks = jnp.clip(jnp.searchsorted(cum, r), 0, n - 1)
         centers = jnp.where(starved[:, None], x[picks], centers)
         return centers, counts
 

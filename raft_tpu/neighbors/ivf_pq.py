@@ -287,6 +287,14 @@ def make_rotation_matrix(
     return q
 
 
+#: row-chunk budget for one Lloyd distance block across all S subspace
+#: problems: [S, chunk, n_centers] f32 stays ≤ this many bytes. Without the
+#: chunking the vmapped iteration materializes [S, n, 256] f32 — 24 GB at
+#: the 1M build's 500k trainset (measured, benchmarks/rss_trace.py) and
+#: ~98 GB at the 10M build's 2M trainset, past any HBM.
+_LLOYD_BLOCK_BYTES = 512 * 1024 * 1024
+
+
 @functools.partial(jax.jit, static_argnames=("n_centers", "n_iters"))
 def _train_codebooks_lloyd(key, subvecs, n_centers: int, n_iters: int,
                            weights=None):
@@ -296,35 +304,67 @@ def _train_codebooks_lloyd(key, subvecs, n_centers: int, n_iters: int,
     contributes nothing). Returns [S, n_centers, pq_len]. vmapped so all
     pq_dim (or n_lists) codebooks train in one XLA program
     (ref: train_per_subset ivf_pq_build.cuh:395 / train_per_cluster :473,
-    which run a kmeans per subspace on residual slices)."""
+    which run a kmeans per subspace on residual slices).
+
+    The assignment step is chunked over trainset rows (lax.scan over
+    [chunk]-row blocks accumulating weighted sums/counts), bounding the
+    distance block at ``_LLOYD_BLOCK_BYTES`` regardless of trainset size —
+    DEEP-scale builds train their codebooks without an O(S·n·k) tensor."""
     S, n, L = subvecs.shape
     if weights is None:
         weights = jnp.ones((S, n), subvecs.dtype)
 
-    def one(key, x, w):
-        # weight-proportional seed draw keeps padding rows out of the init
+    # weight-proportional seed draw, over the UNPADDED rows so the result
+    # is bit-invariant to the chunk size chosen below
+    def draw(key, x, w):
         idx = jax.random.choice(
             key, n, shape=(n_centers,), replace=n < n_centers,
             p=w / jnp.maximum(jnp.sum(w), 1e-12),
         )
-        centers0 = x[idx]
+        return x[idx]
+
+    keys = jax.random.split(key, S)
+    centers_init = jax.vmap(draw)(keys, subvecs, weights)
+
+    # pad rows to a chunk multiple with weight-0 rows (weightless rows
+    # cannot influence sums/counts)
+    chunk = int(np.clip(_LLOYD_BLOCK_BYTES // (4 * S * n_centers), 256, n))
+    n_pad = (-n) % chunk
+    if n_pad:
+        subvecs = jnp.pad(subvecs, ((0, 0), (0, n_pad), (0, 0)))
+        weights = jnp.pad(weights, ((0, 0), (0, n_pad)))
+    n_chunks = (n + n_pad) // chunk
+
+    def one(centers0, x, w):
+        xc = x.reshape(n_chunks, chunk, L)
+        wc = w.reshape(n_chunks, chunk)
 
         def body(centers, _):
-            d2 = (
-                jnp.sum(centers * centers, 1)[None, :]
-                - 2.0 * jnp.matmul(x, centers.T, precision=_PREC)
+            c2 = jnp.sum(centers * centers, 1)[None, :]
+
+            def block(carry, xw):
+                sums, counts = carry
+                xb, wb = xw
+                d2 = c2 - 2.0 * jnp.matmul(xb, centers.T, precision=_PREC)
+                labels = jnp.argmin(d2, axis=1)
+                sums = sums + jax.ops.segment_sum(
+                    xb * wb[:, None], labels, num_segments=n_centers
+                )
+                counts = counts + jax.ops.segment_sum(wb, labels, n_centers)
+                return (sums, counts), None
+
+            (sums, counts), _ = lax.scan(
+                block,
+                (jnp.zeros((n_centers, L), x.dtype), jnp.zeros((n_centers,), x.dtype)),
+                (xc, wc),
             )
-            labels = jnp.argmin(d2, axis=1)
-            sums = jax.ops.segment_sum(x * w[:, None], labels, num_segments=n_centers)
-            counts = jax.ops.segment_sum(w, labels, n_centers)
             new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-12), centers)
             return new, None
 
         centers, _ = lax.scan(body, centers0, None, length=n_iters)
         return centers
 
-    keys = jax.random.split(key, S)
-    return jax.vmap(one)(keys, subvecs, weights)
+    return jax.vmap(one)(centers_init, subvecs, weights)
 
 
 @functools.partial(jax.jit, static_argnames=("codebook_kind",))

@@ -376,6 +376,35 @@ class TestExtendFastPath:
         np.testing.assert_array_equal(np.sort(ids[ids >= 0]), np.arange(4000))
 
 
+def test_lloyd_row_chunking_is_invariant(data, monkeypatch):
+    """Codebook training chunks the assignment step over trainset rows
+    (the O(S·n·k) distance tensor that OOMed DEEP-scale builds); the
+    trained index must be invariant to the chunk size (seed draw happens
+    before padding; per-chunk partial sums only reorder additions)."""
+    x, q = data
+    params = ivf_pq.IndexParams(
+        n_lists=32, kmeans_n_iters=5, pq_dim=16, seed=3,
+        kmeans_trainset_fraction=1.0,
+    )
+    big = ivf_pq.build(params, x)          # n=8000 ⇒ single chunk
+    monkeypatch.setattr(ivf_pq, "_LLOYD_BLOCK_BYTES", 48 * 256 * 4 * 700)
+    # the trainer is jitted and reads the constant at trace time — drop the
+    # cached executable or the second build silently reuses single-chunk
+    ivf_pq._train_codebooks_lloyd.clear_cache()
+    small = ivf_pq.build(params, x)        # S=16 ⇒ forced 2100-row chunks + padding
+    np.testing.assert_allclose(
+        np.asarray(small.codebook), np.asarray(big.codebook), atol=2e-5
+    )
+    sp = ivf_pq.SearchParams(n_probes=8)
+    _, i_big = ivf_pq.search(sp, big, q, 10)
+    _, i_small = ivf_pq.search(sp, small, q, 10)
+    overlap = np.mean([
+        len(set(a) & set(b)) / 10
+        for a, b in zip(np.asarray(i_big), np.asarray(i_small))
+    ])
+    assert overlap >= 0.95, overlap
+
+
 def test_decode_chunking_matches_single_chunk(data, monkeypatch):
     """The list-chunked device decode must be invariant to chunk size
     (regression guard for the HBM-bounded decode path)."""
