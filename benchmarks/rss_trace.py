@@ -1,13 +1,16 @@
 #!/usr/bin/env python
-"""Phase-tagged peak-RSS trace of the IVF-PQ build pipeline.
+"""Phase-tagged peak-RSS trace of the IVF-PQ / IVF-Flat build pipelines.
 
 Answers "where do the bytes go" for the CPU-fallback scale builds
 (scale_build_cpu_*.json showed ~24 GB peak per 10^6 rows — ~60x the
-dataset).  Runs the same pipeline as benchmarks/scale_build.py but
-samples /proc/self/status VmRSS around each build phase via a logger
-hook on the @traced spans, printing a per-phase delta table.
+dataset; root-caused to the un-chunked Lloyd + categorical teleport,
+both fixed).  Runs the same pipeline as benchmarks/scale_build.py but
+samples /proc/self/status VmRSS around each build phase via wrappers
+that block on results, so async device work is charged to the right
+phase.
 
     python benchmarks/rss_trace.py --n 500000
+    python benchmarks/rss_trace.py --n 500000 --index ivf_flat
 """
 
 import argparse
@@ -56,6 +59,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=500_000)
     ap.add_argument("--dim", type=int, default=96)
+    ap.add_argument("--index", default="ivf_pq", choices=("ivf_pq", "ivf_flat"))
     args = ap.parse_args()
 
     import jax
@@ -134,15 +138,33 @@ def main() -> None:
             tag(ipq, fn, fn.lstrip("_"))
 
     smp.phase = "build_other"
-    params = ipq.IndexParams(
-        n_lists=max(1024, n // 1000),
-        pq_dim=d // 2,
-        kmeans_n_iters=10,
-        kmeans_trainset_fraction=min(0.5, 2_000_000 / n),
-        decoded_dtype="auto",
-    )
     t0 = time.time()
-    index = ipq.build(params, x)
+    n_lists = max(1024, n // 1000)
+    trainset_fraction = min(0.5, 2_000_000 / n)
+    if args.index == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat as ifl
+
+        for fn in dir(ifl):
+            if any(s in fn for s in ("_scatter", "_layout")):
+                tag(ifl, fn, fn.lstrip("_"))
+        index = ifl.build(
+            ifl.IndexParams(
+                n_lists=n_lists, kmeans_n_iters=10,
+                kmeans_trainset_fraction=trainset_fraction,
+            ),
+            x,
+        )
+    else:
+        index = ipq.build(
+            ipq.IndexParams(
+                n_lists=n_lists,
+                pq_dim=d // 2,
+                kmeans_n_iters=10,
+                kmeans_trainset_fraction=trainset_fraction,
+                decoded_dtype="auto",
+            ),
+            x,
+        )
     jax.block_until_ready(index.list_data)
     print(f"build {time.time()-t0:.0f}s", flush=True)
 

@@ -138,9 +138,37 @@ def test_extend_fast_path_matches_repack(monkeypatch):
     x = np.asarray(x)[np.random.default_rng(7).permutation(4000)]
     params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
     index = ivf_flat.build(params, x[:3800])
-    extra, ids = x[3800:], jnp.arange(3800, 4000, dtype=jnp.int32)
+    # the fast path needs spare capacity wherever the appended rows land —
+    # guarantee that structurally (not by seed luck: balanced kmeans may
+    # leave the fullest list within a few rows of cap): append perturbed
+    # members of the four emptiest lists, read from the index's REAL
+    # layout (predict-derived labels can diverge from packed membership
+    # when oversized lists were split), so every append targets a list
+    # with hundreds of free slots
+    sizes = np.asarray(index.list_sizes)
+    list_index = np.asarray(index.list_index)
+    small = np.argsort(sizes)[:4]
+    members = np.concatenate([
+        list_index[l, : sizes[l]] for l in small
+    ])[:200].astype(np.int64)
+    assert len(members) == 200, sizes
+    extra = x[:3800][members] + np.float32(1e-3)
+    ids = jnp.arange(3800, 4000, dtype=jnp.int32)
 
+    # spy: the 'fast' call must actually take the fast path, or this test
+    # silently compares repack vs repack
+    alloc_results = []
+    real_alloc = ivf_flat.allocate_append_slots
+
+    def spying_alloc(*a, **k):
+        r = real_alloc(*a, **k)
+        alloc_results.append(r)
+        return r
+
+    monkeypatch.setattr(ivf_flat, "allocate_append_slots", spying_alloc)
     fast = ivf_flat.extend(index, extra, ids)
+    assert alloc_results and alloc_results[-1] is not None, \
+        "fast extend fell back to repack — test premise broken"
     assert fast.list_cap == index.list_cap and fast.n_lists == index.n_lists
     assert fast.size == 4000
 
