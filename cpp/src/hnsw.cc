@@ -139,18 +139,24 @@ std::int32_t greedy_level(const hnsw_index& ix, const float* q, float q2,
 
 // Best-first layer-0 search (algorithm 2): candidates min-heap, results
 // max-heap bounded at ef, visited epoch tags so the scratch array is
-// cleared O(1) per query.
+// cleared O(1) per query. `entries` may hold extra seeds beyond the
+// descended entrypoint (multi-seed mode, see rt_hnsw_search).
 void search_layer0(const hnsw_index& ix, const float* q, float q2, float qnorm,
-                   std::int32_t entry, std::int64_t ef, metric_code metric,
-                   std::vector<std::uint32_t>& visited, std::uint32_t epoch,
+                   const std::vector<std::int32_t>& entries, std::int64_t ef,
+                   metric_code metric, std::vector<std::uint32_t>& visited,
+                   std::uint32_t epoch,
                    std::vector<std::pair<float, std::int32_t>>& out) {
   using pf = std::pair<float, std::int32_t>;
   std::priority_queue<pf, std::vector<pf>, std::greater<pf>> cand;  // min
   std::priority_queue<pf> found;                                    // max
-  float d0 = dist(ix, q, q2, qnorm, entry, metric);
-  cand.emplace(d0, entry);
-  found.emplace(d0, entry);
-  visited[entry] = epoch;
+  for (std::int32_t entry : entries) {
+    if (visited[entry] == epoch) continue;
+    visited[entry] = epoch;
+    float d0 = dist(ix, q, q2, qnorm, entry, metric);
+    cand.emplace(d0, entry);
+    found.emplace(d0, entry);
+  }
+  while (static_cast<std::int64_t>(found.size()) > ef) found.pop();
   while (!cand.empty()) {
     auto [cd, cid] = cand.top();
     if (cd > found.top().first && static_cast<std::int64_t>(found.size()) >= ef)
@@ -180,10 +186,12 @@ void search_layer0(const hnsw_index& ix, const float* q, float q2, float qnorm,
 }
 
 void search_rows(const hnsw_index& ix, const float* queries, std::int64_t k,
-                 std::int64_t ef, metric_code metric, float* out_d,
-                 std::int64_t* out_i, std::int64_t q_begin, std::int64_t q_end,
-                 std::vector<std::uint32_t>& visited,
+                 std::int64_t ef, std::int64_t n_seeds, metric_code metric,
+                 float* out_d, std::int64_t* out_i, std::int64_t q_begin,
+                 std::int64_t q_end, std::vector<std::uint32_t>& visited,
                  std::vector<std::pair<float, std::int32_t>>& scratch) {
+  std::vector<std::int32_t> entries;
+  entries.reserve(std::max<std::int64_t>(n_seeds, 1));
   for (std::int64_t qi = q_begin; qi < q_end; ++qi) {
     const float* q = queries + qi * ix.dim;
     float q2 = 0.f;
@@ -192,9 +200,17 @@ void search_rows(const hnsw_index& ix, const float* queries, std::int64_t k,
     std::int32_t cur = ix.entrypoint;
     for (int level = ix.max_level; level >= 1; --level)
       cur = greedy_level(ix, q, q2, qnorm, cur, level, metric);
+    entries.clear();
+    entries.push_back(cur);
+    // multi-seed mode (n_seeds > 1): extra evenly-strided starts cover
+    // regions a single greedy descent cannot reach — directed CAGRA
+    // graphs and non-metric (MIP) spaces route poorly from one entry
+    for (std::int64_t s = 1; s < n_seeds; ++s)
+      entries.push_back(
+          static_cast<std::int32_t>((s * ix.n) / n_seeds));
     // epoch = query index + 1 (0 is "never visited"); wraps are impossible
     // within one call since epochs only grow
-    search_layer0(ix, q, q2, qnorm, cur, std::max(ef, k), metric, visited,
+    search_layer0(ix, q, q2, qnorm, entries, std::max(ef, k), metric, visited,
                   static_cast<std::uint32_t>(qi + 1), scratch);
     for (std::int64_t j = 0; j < k; ++j) {
       if (j < static_cast<std::int64_t>(scratch.size())) {
@@ -367,12 +383,15 @@ int rt_hnsw_element(void* handle, std::int64_t i, float* out_vec,
 // level 0.  Threaded over queries (same pattern as rt_refine_host).
 // Returned ids are the stored labels, like hnswlib's knn_query.
 int rt_hnsw_search(void* handle, const float* queries, std::int64_t n_q,
-                   std::int64_t k, std::int64_t ef, int metric, float* out_d,
-                   std::int64_t* out_i, std::int64_t n_threads) {
+                   std::int64_t k, std::int64_t ef, std::int64_t n_seeds,
+                   int metric, float* out_d, std::int64_t* out_i,
+                   std::int64_t n_threads) {
   try {
     auto* ix = static_cast<hnsw_index*>(handle);
     if (!ix) throw std::runtime_error("hnsw: null handle");
     if (k <= 0 || n_q < 0) throw std::runtime_error("hnsw: bad k or n_q");
+    if (n_seeds < 1) n_seeds = 1;
+    n_seeds = std::min<std::int64_t>(n_seeds, ix->n);
     metric_code mc = static_cast<metric_code>(metric);
     std::int64_t nt = std::max<std::int64_t>(
         1, std::min<std::int64_t>(
@@ -397,8 +416,8 @@ int rt_hnsw_search(void* handle, const float* queries, std::int64_t n_q,
       if (b >= e) break;
       threads.emplace_back([&, t, b, e] {
         try {
-          search_rows(*ix, queries, k, ef, mc, out_d, out_i, b, e, visited[t],
-                      scratch[t]);
+          search_rows(*ix, queries, k, ef, n_seeds, mc, out_d, out_i, b, e,
+                      visited[t], scratch[t]);
         } catch (const std::exception& ex) {
           errors[t] = ex.what();
         } catch (...) {

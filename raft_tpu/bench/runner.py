@@ -410,15 +410,18 @@ class HnswNativeANN(HnswANN):
         self._native = hnsw.load_native(self._path, self._dim)
         self._threads = 0
         self._ef = 64
+        self._n_seeds = 1
 
     def set_search_param(self, param):
         super().set_search_param(param)
         self._threads = int(param.get("n_threads", 0))
+        self._n_seeds = int(param.get("n_seeds", 1))
 
     def search(self, queries, k):
         d, ids = self._native.search(
             np.asarray(queries, np.float32), k, ef=self._ef,
-            metric=self.metric, n_threads=self._threads,
+            metric=self.metric, n_seeds=self._n_seeds,
+            n_threads=self._threads,
         )
         return d, ids.astype(np.int32)
 

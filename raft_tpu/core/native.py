@@ -184,8 +184,8 @@ def _load():
         lib.rt_hnsw_search.restype = ctypes.c_int
         lib.rt_hnsw_search.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.rt_hnsw_free.argtypes = [ctypes.c_void_p]
         _LIB = lib
@@ -546,11 +546,14 @@ class HnswNativeIndex:
 
     def search(
         self, queries: np.ndarray, k: int, ef: int = 64,
-        metric: str = "sqeuclidean", n_threads: int = 0,
+        metric: str = "sqeuclidean", n_seeds: int = 1, n_threads: int = 0,
     ):
         """hnswlib-semantics knn_query: greedy upper-level descent then
-        ef-bounded best-first at layer 0. Returns (distances [q, k] f32,
-        labels [q, k] i64)."""
+        ef-bounded best-first at layer 0. ``n_seeds > 1`` adds evenly-
+        strided extra layer-0 starts — the escape hatch for directed
+        CAGRA graphs / MIP spaces where a single-entry search routes
+        poorly (stock hnswlib has no analog; default 1 keeps its exact
+        semantics). Returns (distances [q, k] f32, labels [q, k] i64)."""
         if metric not in _METRIC_CODES:
             raise ValueError(f"unsupported hnsw metric {metric!r}")
         queries = np.ascontiguousarray(queries, np.float32)
@@ -561,7 +564,7 @@ class HnswNativeIndex:
         out_i = np.empty((n_q, k), np.int64)
         code = _lib().rt_hnsw_search(
             self._h, queries.ctypes.data_as(ctypes.c_void_p), n_q, int(k),
-            int(ef), _METRIC_CODES[metric],
+            int(ef), int(n_seeds), _METRIC_CODES[metric],
             out_d.ctypes.data_as(ctypes.c_void_p),
             out_i.ctypes.data_as(ctypes.c_void_p), n_threads,
         )

@@ -37,7 +37,8 @@ from raft_tpu.neighbors import cagra
 from raft_tpu.core.trace import traced
 
 
-def _build_hierarchy(data: np.ndarray, max_m: int, seed: int):
+def _build_hierarchy(data: np.ndarray, max_m: int, seed: int,
+                     metric: str = "sqeuclidean"):
     """Geometric level assignment + per-level kNN links — the upper layers
     a real HNSW carries (Malkov & Yashunin §4: P(level ≥ l) = M^-l, each
     layer a kNN graph over its members).
@@ -69,10 +70,12 @@ def _build_hierarchy(data: np.ndarray, max_m: int, seed: int):
             upper[lvl] = (members, np.zeros((len(members), 0), np.uint32))
             continue
         sub = data[members]
-        # self lands at rank 0 (distance 0); request one extra and drop it.
+        # neighbors under the INDEX metric (an L2 hierarchy over an
+        # inner-product graph routes descent to the wrong region); self
+        # usually lands at rank 0 — request one extra and drop it.
         # brute_force.knn tiles device-side, so the per-level cost is the
         # exact-kNN of the ~n/M^l member subset, not an n x n scan.
-        _, nb = brute_force.knn(sub, sub, k_l + 1)
+        _, nb = brute_force.knn(sub, sub, k_l + 1, metric=metric)
         nb = np.asarray(nb).astype(np.int64)
         # drop self per row, vectorized: stable-sort self slots last, keep
         # the first k_l (original neighbor order preserved for the rest)
@@ -101,7 +104,9 @@ def serialize_to_hnswlib(
     deg = graph.shape[1]
     max_m = deg // 2
     if hierarchy:
-        levels, upper = _build_hierarchy(data, max_m, seed)
+        levels, upper = _build_hierarchy(data, max_m, seed,
+                                         metric=getattr(index, "metric",
+                                                        "sqeuclidean"))
         max_level = int(levels.max())
         entrypoint = int(np.argmax(levels))
     else:

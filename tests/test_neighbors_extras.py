@@ -365,6 +365,34 @@ def test_hnsw_native_cross_validation(tmp_path, data):
     assert agree >= 0.8, agree
 
 
+def test_hnsw_native_multi_seed_recovers_hard_spaces(tmp_path):
+    """n_seeds > 1 (evenly-strided extra layer-0 starts) must lift recall
+    where single-entry routing fails — inner-product spaces hub-collapse
+    (MIP is not a metric, greedy descent gravitates to large-norm rows)."""
+    from raft_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    import jax as _jax
+    from raft_tpu.random import make_blobs
+
+    x, _, _ = make_blobs(_jax.random.PRNGKey(5), 4000, 48, n_clusters=32)
+    x = np.asarray(x)
+    q = x[np.random.default_rng(5).integers(0, 4000, 100)]
+    index = cagra.build(
+        cagra.IndexParams(metric="inner_product", graph_degree=16), x)
+    fn = str(tmp_path / "ip.hnsw")
+    hnsw.serialize_to_hnswlib(fn, index)
+    nix = hnsw.load_native(fn, dim=48)
+    _, gt = brute_force.knn(x, q, 10, metric="inner_product")
+    _, one = nix.search(q, 10, ef=96, metric="inner_product", n_seeds=1)
+    _, many = nix.search(q, 10, ef=96, metric="inner_product", n_seeds=96)
+    r1 = float(neighborhood_recall(one, np.asarray(gt)))
+    rm = float(neighborhood_recall(many, np.asarray(gt)))
+    assert rm >= r1 - 1e-6, (r1, rm)
+    assert rm >= 0.9, (r1, rm)
+
+
 def test_hnsw_native_rejects_bad_files(tmp_path, data):
     from raft_tpu.core import native
 
