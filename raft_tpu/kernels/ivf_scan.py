@@ -92,17 +92,23 @@ def _score_against_list(dec, qg, q2, y2_row, ids_row, filt_row, scale,
     else:
         # MXU: [G, rot] × [cap, rot]ᵀ; stored rows upcast in VMEM only.
         # scan_dtype mirrors the caller's XLA schedule so the two legs
-        # rank ties the same way: "highest" = f32 + HIGHEST (ivf_flat /
-        # pairwise._PREC), "float32"/"bfloat16" = the ivf_pq lut_dtype
-        # ladder at MXU default precision
+        # rank ties the same way: "highest"/"float32" = f32 compute,
+        # "bfloat16" = the ivf_pq lut_dtype ladder's bf16 compute
         sd = jnp.bfloat16 if scan_dtype == "bfloat16" else jnp.float32
+        # precision parity with the XLA legs, measured on-chip (round 4):
+        # Mosaic's DEFAULT f32 dot is a single bf16 pass, while XLA's f32
+        # DEFAULT keeps ~f32 fidelity — near-equal candidates then rank
+        # differently between the legs (id agreement 0.955 on clustered
+        # bf16-storage data).  "float32" pins HIGHEST to match XLA's
+        # effective precision; "bfloat16" casts both operands to bf16
+        # first, so DEFAULT is already bit-matched to the XLA bf16 dot.
         ip = jax.lax.dot_general(
             qg.astype(sd), dec.astype(sd),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=(
-                jax.lax.Precision.HIGHEST if scan_dtype == "highest"
-                else jax.lax.Precision.DEFAULT
+                jax.lax.Precision.DEFAULT if scan_dtype == "bfloat16"
+                else jax.lax.Precision.HIGHEST
             ),
         )                                                # [G, cap]
     if metric == "inner_product":
@@ -250,7 +256,7 @@ def ivf_scan_probe_major(
 def _scan_qm_kernel(probes_ref, dec_ref, y2_ref, ids_ref, filt_ref, q_ref,
                     q2_ref, scale_ref, vals_ref, out_ids_ref, s_v, s_i, *,
                     kk: int, metric: str, filtered: bool, scan_dtype: str,
-                    P: int, G: int, cap: int):
+                    P: int, G: int, cap: int, cap_pad: int):
     """One (query-block, probe, member) step of the fused query-major
     scan: score member ``i``'s probe-``p`` list into the block's VMEM
     score scratch; after the block's last (p, i) step, ONE fold over the
@@ -265,13 +271,24 @@ def _scan_qm_kernel(probes_ref, dec_ref, y2_ref, ids_ref, filt_ref, q_ref,
         filt_ref[0], scale_ref[0, 0],
         metric=metric, filtered=filtered, scan_dtype=scan_dtype,
     )                                                    # [1, cap] each
+    # scratch rows are lane-padded to cap_pad: merging (G, P, cap) to
+    # (G, P*cap) is a Mosaic "unsupported shape cast" whenever cap isn't
+    # a lane multiple (real indexes: cap=632), so pad slots carry
+    # _WORST/-1 and the aligned pool reshapes for ONE G-wide fold
+    if cap_pad > cap:
+        scores = jnp.concatenate(
+            [scores, jnp.full((1, cap_pad - cap), _WORST, scores.dtype)], 1
+        )
+        cand_i = jnp.concatenate(
+            [cand_i, jnp.full((1, cap_pad - cap), -1, cand_i.dtype)], 1
+        )
     s_v[i, p, :] = scores[0]
     s_i[i, p, :] = cand_i[0]
 
     @pl.when((p == P - 1) & (i == G - 1))
     def _fold():
-        pool_v = s_v[...].reshape(G, P * cap)
-        pool_i = s_i[...].reshape(G, P * cap)
+        pool_v = s_v[...].reshape(G, P * cap_pad)
+        pool_i = s_i[...].reshape(G, P * cap_pad)
         run_v = jnp.full((G, kk), _WORST, jnp.float32)
         run_i = jnp.full((G, kk), -1, jnp.int32)
         v, o = fold_topk(run_v, run_i, pool_v, pool_i, kk)
@@ -290,10 +307,18 @@ _QM_GROUP = 8
 QM_VMEM_BUDGET = 6 * 1024 * 1024
 
 
+def _cap_pad(cap: int) -> int:
+    """Lane-padded scratch row width — the ONE owner of the padding rule
+    (scratch rows pad to a 128 multiple so the fold's pool reshape is a
+    supported Mosaic relayout; see _scan_qm_kernel)."""
+    return -(-cap // 128) * 128
+
+
 def qm_scratch_bytes(n_probes: int, cap: int) -> int:
     """VMEM score+id scratch the query-major kernel allocates per block —
-    the dispatch gates on this (one owner for the formula and _QM_GROUP)."""
-    return 2 * _QM_GROUP * n_probes * cap * 4
+    the dispatch gates on this (one owner for the formula and _QM_GROUP).
+    cap counts lane-padded (scratch rows are padded to a 128 multiple)."""
+    return 2 * _QM_GROUP * n_probes * _cap_pad(cap) * 4
 
 
 def qm_query_tile(n_probes: int) -> int:
@@ -330,8 +355,9 @@ def ivf_scan_query_major(
     the XLA query-major leg pre-postprocess.  Q must be a multiple of
     the group width (pad with q2=+inf rows; their outputs are -1/inf).
 
-    VMEM budget: the scratch holds 2·G·P·cap·4 bytes — callers gate on
-    this (see ivf_pq's dispatch) and fall back to XLA past it."""
+    VMEM budget: the scratch holds 2·G·P·cap_pad·4 bytes (cap lane-padded
+    to a 128 multiple; ``qm_scratch_bytes`` is the owner) — callers gate
+    on this (see ivf_pq's dispatch) and fall back to XLA past it."""
     Q, P = probes.shape
     L, cap, rot = list_data.shape
     G = _QM_GROUP
@@ -341,6 +367,7 @@ def ivf_scan_query_major(
     if not filtered:
         list_filter = jnp.zeros((L, 1), jnp.uint32)
     cap_w = list_filter.shape[1]
+    cap_pad = _cap_pad(cap)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -373,14 +400,14 @@ def ivf_scan_query_major(
             pl.BlockSpec((1, G, kk), lambda qb, p, i, pr: (qb, 0, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((G, P, cap), jnp.float32),
-            pltpu.VMEM((G, P, cap), jnp.int32),
+            pltpu.VMEM((G, P, cap_pad), jnp.float32),
+            pltpu.VMEM((G, P, cap_pad), jnp.int32),
         ],
     )
     vals, ids = pl.pallas_call(
         functools.partial(
             _scan_qm_kernel, kk=kk, metric=metric, filtered=filtered,
-            scan_dtype=scan_dtype, P=P, G=G, cap=cap,
+            scan_dtype=scan_dtype, P=P, G=G, cap=cap, cap_pad=cap_pad,
         ),
         grid_spec=grid_spec,
         out_shape=[

@@ -29,9 +29,39 @@ from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _refine_jit(dataset, queries, candidates, k: int, metric: str):
+#: per-tile candidate-gather budget: the [tile, k', d] f32 gather (plus
+#: XLA's copy of it) must fit HBM next to the dataset — an unbounded
+#: gather OOMed the chip at CAGRA-build scale (100k queries × 258
+#: candidates × 96 dims → 30.8 GB program; ladder config4, round 4)
+_REFINE_TILE_BYTES = 512 * 1024 * 1024
+
+
+def _refine_query_tile(q: int, kprime: int, d: int) -> int:
+    per_row = kprime * d * 4
+    tile = max(8, _REFINE_TILE_BYTES // max(1, per_row))
+    return min(q, 1 << (tile.bit_length() - 1))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "tile"))
+def _refine_jit(dataset, queries, candidates, k: int, metric: str,
+                tile: int | None = None):
     q, kprime = candidates.shape
+    if tile is not None and tile < q:
+        pad = -q % tile
+        qs = jnp.pad(queries, ((0, pad), (0, 0))).reshape(
+            -1, tile, queries.shape[1]
+        )
+        cs = jnp.pad(
+            candidates, ((0, pad), (0, 0)), constant_values=-1
+        ).reshape(-1, tile, kprime)
+        v, i = jax.lax.map(
+            lambda t: _refine_tile(dataset, t[0], t[1], k, metric), (qs, cs)
+        )
+        return v.reshape(-1, k)[:q], i.reshape(-1, k)[:q]
+    return _refine_tile(dataset, queries, candidates, k, metric)
+
+
+def _refine_tile(dataset, queries, candidates, k: int, metric: str):
     safe = jnp.clip(candidates, 0, dataset.shape[0] - 1)
     cand = dataset[safe].astype(jnp.float32)          # [q, k', d] gather
     qf = queries.astype(jnp.float32)
@@ -80,7 +110,13 @@ def refine(
         return _refine_host(
             np.asarray(dataset), np.asarray(queries), np.asarray(candidates), k, canonical
         )
-    return _refine_jit(jnp.asarray(dataset), jnp.asarray(queries), candidates, int(k), canonical)
+    tile = _refine_query_tile(
+        candidates.shape[0], candidates.shape[1], dataset.shape[1]
+    )
+    return _refine_jit(
+        jnp.asarray(dataset), jnp.asarray(queries), candidates, int(k),
+        canonical, tile=tile,
+    )
 
 
 def _refine_host(dataset, queries, candidates, k, metric):

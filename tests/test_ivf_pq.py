@@ -85,6 +85,27 @@ def test_refine_improves_recall(built, data):
     np.testing.assert_array_equal(np.asarray(idx), np.asarray(idx_h))
 
 
+def test_refine_query_tiling_equivalent(data):
+    """The query-tiled device refine (round 4: an unbounded [q, k', d]
+    gather OOMed the chip at CAGRA-build scale) must match the untiled
+    path bit-for-bit on every metric."""
+    from raft_tpu.neighbors.refine import _refine_jit, _refine_query_tile
+
+    x, q = data
+    rng = np.random.default_rng(3)
+    cand = jnp.asarray(
+        rng.integers(-1, x.shape[0], (q.shape[0], 37)).astype(np.int32)
+    )
+    assert _refine_query_tile(100_000, 258, 96) == 4096  # the OOM shape
+    for metric in ("sqeuclidean", "euclidean", "inner_product", "cosine"):
+        v0, i0 = _refine_jit(x, q, cand, 10, metric, tile=None)
+        v1, i1 = _refine_jit(x, q, cand, 10, metric, tile=32)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(
+            np.asarray(v0), np.asarray(v1), rtol=1e-5, atol=1e-6
+        )
+
+
 def test_per_cluster_codebook(data):
     x, q = data
     params = ivf_pq.IndexParams(

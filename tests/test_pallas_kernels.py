@@ -185,7 +185,7 @@ class TestPallasCompilesOnTpu:
             os.environ.pop("RAFT_TPU_PALLAS", None)
         assert (np.asarray(i_x) == np.asarray(i_p)).mean() >= 0.99
 
-    @pytest.mark.parametrize("decoded_dtype", ["bfloat16", "int8"])
+    @pytest.mark.parametrize("decoded_dtype", ["float32", "bfloat16", "int8"])
     def test_ivf_scan_query_major_compiles(self, decoded_dtype):
         """The query-major kernel adds a 3-axis grid, VMEM score scratch,
         and a group-end fold — Mosaic must take all three."""
