@@ -126,8 +126,36 @@ def main() -> None:
         keep = set(args.algos.split(","))
         grids = [g for g in grids if g[0] in keep]
 
-    results = []
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), f"frontier_{platform}.json"
+    )
+    # per-algo checkpoint: a tunnel death mid-sweep must not lose the
+    # completed algos' measurements (a 1M sweep is ~10 min/algo on chip) —
+    # each finished algo appends to <out>.partial and a restart resumes
+    # from it, re-running only what's missing
+    part_path = out + ".partial"
+    done_algos, results = set(), []
+    if os.path.exists(part_path):
+        try:
+            with open(part_path) as fh:
+                part = json.load(fh)
+            if (part.get("n"), part.get("k")) == (n, args.k):
+                done_algos = set(part["done_algos"])
+                results = [runner.RunResult(**d) for d in part["results"]]
+                print(f"resuming from {part_path}: {sorted(done_algos)} done")
+        except Exception as e:
+            print(f"ignoring unreadable partial ({e})")
+
+    def checkpoint():
+        with open(part_path, "w") as fh:
+            json.dump(
+                {"n": n, "k": args.k, "done_algos": sorted(done_algos),
+                 "results": [r.to_dict() for r in results]}, fh,
+            )
+
     for name, build_param, search_params in grids:
+        if name in done_algos:
+            continue
         t0 = time.time()
         try:
             rs = runner.run_case(
@@ -136,8 +164,19 @@ def main() -> None:
             )
         except Exception as e:  # record the failure, keep the sweep going
             print(f"{name}: FAILED ({e})")
+            if "unavailable" in str(e).lower():
+                # the backend (tunnel) died, not the algo — keep it
+                # un-done so the resume retries it, and abort instead of
+                # failing every remaining algo against a dead chip
+                checkpoint()
+                print("backend unavailable — aborting; checkpoint kept")
+                sys.exit(1)
+            done_algos.add(name)
+            checkpoint()
             continue
         results.extend(rs)
+        done_algos.add(name)
+        checkpoint()
         good = [r for r in rs if r.recall >= 0.9] or rs
         best = max(good, key=lambda r: r.qps)
         print(
@@ -146,9 +185,6 @@ def main() -> None:
             f"{best.qps:.0f} qps @ {best.recall:.3f}"
         )
 
-    out = args.out or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"frontier_{platform}.json"
-    )
     doc = {
         "platform": platform,
         "n": n,
@@ -161,6 +197,8 @@ def main() -> None:
     }
     with open(out, "w") as fh:
         json.dump(doc, fh, indent=2)
+    if os.path.exists(part_path):
+        os.remove(part_path)
     print("wrote", out)
     try:
         plot.plot_results(results, out.replace(".json", ".png"),
