@@ -71,17 +71,51 @@ def main() -> None:
         kmeans_trainset_fraction=min(0.5, 2_000_000 / n),
         decoded_dtype=args.decoded_dtype,
     )
-    print(f"building ivf_pq n={n} n_lists={n_lists}...", flush=True)
-    t0 = time.time()
-    index = ivf_pq.build(params, x)
-    jax.block_until_ready(index.list_data)
-    build_s = time.time() - t0
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"scale_build_{platform}_n{n}.json",
+    )
+    # build-phase checkpoint: a 10M on-chip build is ~half a tunnel
+    # window; if the tunnel dies during the later search ladder, the
+    # retry must not pay the build again.  The built index serializes
+    # next to the artifact and a restart with matching params loads it.
+    cache = out + ".index"
+    meta_path = cache + ".meta"
+    sig = {"n": n, "dim": d, "n_lists": n_lists,
+           "pq_dim": args.pq_dim or d // 2, "decoded": args.decoded_dtype}
+    resumed = False
+    if os.path.exists(cache) and os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        if meta.get("sig") == sig:
+            print(f"resuming: loading built index from {cache}", flush=True)
+            index = ivf_pq.load(cache)
+            build_s = meta["build_s"]
+            resumed = True
+        else:
+            print("ignoring stale index cache (param mismatch)", flush=True)
+    if not resumed:
+        print(f"building ivf_pq n={n} n_lists={n_lists}...", flush=True)
+        t0 = time.time()
+        index = ivf_pq.build(params, x)
+        jax.block_until_ready(index.list_data)
+        build_s = time.time() - t0
+        ivf_pq.save(cache, index)
+        import resource as _res
+
+        with open(meta_path, "w") as fh:
+            json.dump({"sig": sig, "build_s": build_s,
+                       "peak_rss_gb": _res.getrusage(
+                           _res.RUSAGE_SELF).ru_maxrss / 2**20}, fh)
     # peak host RSS over the build (the streamed-assemble memory claim:
     # host keeps the dataset + compressed code stream, never a padded
     # decoded copy); ru_maxrss is KiB on Linux
     import resource
 
     peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+    if resumed:  # build-phase RSS belongs to the original (checkpointing) run
+        with open(meta_path) as fh:
+            peak_rss_gb = max(peak_rss_gb, json.load(fh).get("peak_rss_gb", 0.0))
     foot = helpers.index_memory_footprint(index)
     print(
         f"build {build_s:.0f}s; cache dtype {index.list_data.dtype}; "
@@ -159,10 +193,6 @@ def main() -> None:
     jax.block_until_ready(index2.list_data)
     extend_s = time.time() - t0
 
-    out = args.out or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        f"scale_build_{platform}_n{n}.json",
-    )
     with open(out, "w") as fh:
         json.dump(
             {
@@ -183,6 +213,9 @@ def main() -> None:
             fh,
             indent=2,
         )
+    for p in (cache, meta_path):   # done — drop the multi-GB checkpoint
+        if os.path.exists(p):
+            os.remove(p)
     print("wrote", out)
 
 
