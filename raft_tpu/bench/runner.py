@@ -128,6 +128,14 @@ class IvfPqANN(ANN):
         self._mod.save(path, self._index)
 
 
+#: single-slot CAGRA build cache: the bf16/VPQ bench variants share the
+#: plain variant's graph (they differ only in the traversal dataset's
+#: representation), so a frontier sweep pays the ~20-min 1M graph build
+#: once instead of three times.  One slot keeps device-memory pinning
+#: bounded (the dense index stays resident until a different key lands).
+_CAGRA_BUILD_CACHE: dict = {}
+
+
 class CagraANN(ANN):
     name = "raft_tpu_cagra"
 
@@ -142,15 +150,29 @@ class CagraANN(ANN):
         compress = bp.pop("compress", False)
         # "dataset_dtype": "bfloat16" stores the traversal dataset in bf16
         # — halves the hot loop's gather bytes (the reference's half-
-        # precision dataset template, cagra_types.hpp:142)
+        # precision dataset template, cagra_types.hpp:142).  The graph is
+        # built (and cached) at full precision; the dtype only changes the
+        # stored traversal rows, mirroring the reference's semantics.
         ds_dtype = bp.pop("dataset_dtype", None)
         params = cagra.IndexParams(metric=self.metric, **bp)
         ds = jnp.asarray(dataset)
+        sample = np.asarray(dataset[: min(256, dataset.shape[0])])
+        key = (dataset.shape, str(sample.dtype), hash(sample.tobytes()),
+               self.metric, tuple(sorted(bp.items())))
+        base = _CAGRA_BUILD_CACHE.get(key)
+        if base is None:
+            base = cagra.build(params, ds)
+            _CAGRA_BUILD_CACHE.clear()
+            _CAGRA_BUILD_CACHE[key] = base
+        index = base
         if ds_dtype:
-            ds = ds.astype(ds_dtype)
-        self._index = cagra.build(params, ds)
+            index = cagra.Index(
+                base.metric, base.dataset.astype(ds_dtype), base.graph,
+                base.entry_centers, base.entry_ids,
+            )
         if compress:
-            self._index = cagra.compress(self._index)
+            index = cagra.compress(base)
+        self._index = index
         self._sp = cagra.SearchParams()
 
     def set_search_param(self, param):
