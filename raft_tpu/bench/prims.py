@@ -225,11 +225,28 @@ def _cases() -> List[Dict]:
 
 
 def run(filter_: str = "", out_path: str = "") -> List[Dict]:
+    import os
+
     import jax
 
-    results = []
+    # per-case checkpoint (mirrors benchmarks/frontier.py): an on-chip
+    # sweep killed by a tunnel death resumes from <out>.partial instead
+    # of re-timing every completed case
+    part = out_path + ".partial" if out_path else ""
+    results: List[Dict] = []
+    done = set()
+    if part and os.path.exists(part):
+        try:
+            with open(part) as f:
+                results = json.load(f)
+            done = {r["name"] for r in results}
+            print(f"resuming from {part}: {len(done)} cases done")
+        except Exception:
+            results, done = [], set()
     for case in _cases():
         if filter_ and filter_ not in case["name"]:
+            continue
+        if case["name"] in done:
             continue
         s = _timeit(case["fn"], case["args"])
         row = {
@@ -241,9 +258,14 @@ def run(filter_: str = "", out_path: str = "") -> List[Dict]:
         }
         results.append(row)
         print(json.dumps(row))
+        if part:
+            with open(part, "w") as f:
+                json.dump(results, f)
     if out_path:
         with open(out_path, "w") as f:
             json.dump(results, f, indent=2)
+        if part and os.path.exists(part):
+            os.remove(part)
     return results
 
 
