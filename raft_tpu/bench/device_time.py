@@ -93,12 +93,16 @@ def plane_busy_ps(xplane_pb: bytes) -> Dict[str, int]:
 
 
 def device_busy_seconds(log_dir: str) -> Optional[float]:
-    """Total device busy time recorded under a ``jax.profiler.trace``
-    log dir, or None when no device plane exists (host-only backend)."""
+    """Device busy time recorded under a ``jax.profiler.trace`` log dir,
+    or None when no device plane exists (host-only backend).  Busiest
+    device plane, not the sum: one chip dumps several "/device:" planes
+    (compute plus DMA/non-core lanes), and summing them double-counted
+    overlap — round-4's on-chip ladder showed device time exceeding wall
+    time, which is impossible for a single invocation."""
     dumps = glob.glob(
         os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True
     )
-    total_ps = 0
+    busiest_ps = 0
     seen_device = False
     for path in dumps:
         with open(path, "rb") as fh:
@@ -106,8 +110,8 @@ def device_busy_seconds(log_dir: str) -> Optional[float]:
         for name, ps in planes.items():
             if name.startswith("/device:"):
                 seen_device = True
-                total_ps += ps
-    return total_ps / 1e12 if seen_device else None
+                busiest_ps = max(busiest_ps, ps)
+    return busiest_ps / 1e12 if seen_device else None
 
 
 def measure_device_time(fn, *args) -> Optional[float]:

@@ -302,6 +302,44 @@ class TestDeviceTime:
         assert device_time.device_busy_seconds(d) is None
         assert device_time.measure_device_time(f, x) is None
 
+    def test_busiest_device_plane_not_sum(self, tmp_path):
+        """One chip dumps several /device: planes (compute + DMA lanes);
+        summing them double-counts overlap — the round-4 on-chip ladder
+        showed device time > wall time. The counter must report the
+        busiest plane."""
+        from raft_tpu.bench import device_time
+
+        def varint(v):
+            out = b""
+            while True:
+                b7, v = v & 0x7F, v >> 7
+                out += bytes([b7 | (0x80 if v else 0)])
+                if not v:
+                    return out
+
+        def ld(field, payload):   # length-delimited field
+            return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+        def event(dur_ps):        # XEvent.duration_ps = field 3 varint
+            return varint((3 << 3) | 0) + varint(dur_ps)
+
+        def plane(name, *line_durs):
+            p = ld(2, name.encode())                       # XPlane.name
+            for dur in line_durs:
+                p += ld(3, ld(4, event(dur)))              # lines[].events[]
+            return ld(1, p)                                # XSpace.planes
+
+        space = (
+            plane("/device:TPU:0", 200_000, 150_000)       # busiest: 200k
+            + plane("/device:TPU:0 non-core", 180_000)
+            + plane("/host:CPU", 999_000)                  # ignored
+        )
+        d = tmp_path / "t" / "x"
+        d.mkdir(parents=True)
+        (d / "a.xplane.pb").write_bytes(space)
+        got = device_time.device_busy_seconds(str(tmp_path / "t"))
+        assert got == pytest.approx(200_000 / 1e12)
+
     def test_run_case_carries_device_fields(self, ds):
         import jax
 
