@@ -139,7 +139,16 @@ def main() -> None:
         try:
             with open(part_path) as fh:
                 part = json.load(fh)
-            if (part.get("n"), part.get("k")) == (n, args.k):
+            # dataset is part of the signature: a leftover partial from a
+            # different --dataset with matching n/k must not merge stale
+            # measurements into this artifact.  Partials written before
+            # the dataset key existed all came from the parser-default
+            # dataset — pin them to it, NOT to args.dataset (defaulting
+            # to args.dataset would resurrect exactly the cross-dataset
+            # merge this guard exists to stop).
+            if (part.get("n"), part.get("k"),
+                    part.get("dataset", "deep-image-96-inner")
+                    ) == (n, args.k, args.dataset):
                 done_algos = set(part["done_algos"])
                 results = [runner.RunResult(**d) for d in part["results"]]
                 print(f"resuming from {part_path}: {sorted(done_algos)} done")
@@ -149,7 +158,8 @@ def main() -> None:
     def checkpoint():
         with open(part_path, "w") as fh:
             json.dump(
-                {"n": n, "k": args.k, "done_algos": sorted(done_algos),
+                {"n": n, "k": args.k, "dataset": args.dataset,
+                 "done_algos": sorted(done_algos),
                  "results": [r.to_dict() for r in results]}, fh,
             )
 
@@ -185,6 +195,16 @@ def main() -> None:
             f"{best.qps:.0f} qps @ {best.recall:.3f}"
         )
 
+    # per-algo build cost, first-class (VERDICT r4 next #4: build time
+    # gates alongside the QPS pareto — search wins don't excuse
+    # uncompetitive builds).  CAGRA variants report the real shared
+    # graph-build cost, not cache-hit time (runner build cache).
+    build_seconds = {}
+    for r in results:
+        build_seconds[r.algo] = max(
+            build_seconds.get(r.algo, 0.0), r.build_time_s)
+    for a, bs in sorted(build_seconds.items()):
+        print(f"build_s {a}: {bs:.1f}")
     doc = {
         "platform": platform,
         "n": n,
@@ -192,6 +212,7 @@ def main() -> None:
         "n_queries": int(ds.queries.shape[0]),
         "k": args.k,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "build_seconds": build_seconds,
         "frontiers": {a: pts for a, pts in plot.group_frontiers(results).items()},
         "results": [r.to_dict() for r in results],
     }

@@ -98,7 +98,14 @@ def device_busy_seconds(log_dir: str) -> Optional[float]:
     device plane, not the sum: one chip dumps several "/device:" planes
     (compute plus DMA/non-core lanes), and summing them double-counted
     overlap — round-4's on-chip ladder showed device time exceeding wall
-    time, which is impossible for a single invocation."""
+    time, which is impossible for a single invocation.
+
+    Multi-device semantics: across several chips the max-over-planes is
+    the busiest single chip's busy time — a wall-clock-like QPS
+    denominator for SPMD work (all chips run the same program in
+    lockstep), NOT aggregate device work.  Do not read it as total busy
+    seconds across the fleet; for per-chip accounting group planes by
+    device ordinal instead."""
     dumps = glob.glob(
         os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True
     )

@@ -159,11 +159,22 @@ class CagraANN(ANN):
         sample = np.asarray(dataset[: min(256, dataset.shape[0])])
         key = (dataset.shape, str(sample.dtype), hash(sample.tobytes()),
                self.metric, tuple(sorted(bp.items())))
-        base = _CAGRA_BUILD_CACHE.get(key)
-        if base is None:
+        cached = _CAGRA_BUILD_CACHE.get(key)
+        if cached is None:
+            t0 = time.perf_counter()
             base = cagra.build(params, ds)
+            jax.block_until_ready(base.graph)
+            build_s = time.perf_counter() - t0
             _CAGRA_BUILD_CACHE.clear()
-            _CAGRA_BUILD_CACHE[key] = base
+            _CAGRA_BUILD_CACHE[key] = (base, build_s)
+            self._cache_hit = False
+        else:
+            base, build_s = cached
+            self._cache_hit = True
+        # the real (shared) graph-build cost: a cache hit must not report
+        # ~0s build_time_s in frontier artifacts — ann-bench semantics are
+        # true per-algo build measurement, and the variants share one build
+        self.shared_build_s = build_s
         index = base
         if ds_dtype:
             index = cagra.Index(
@@ -171,7 +182,7 @@ class CagraANN(ANN):
                 base.entry_centers, base.entry_ids,
             )
         if compress:
-            index = cagra.compress(base)
+            index = cagra.compress(index)
         self._index = index
         self._sp = cagra.SearchParams()
 
@@ -513,6 +524,12 @@ def run_case(
     algo.build(ds.base)
     jax.block_until_ready(getattr(algo, "_index", jnp.zeros(())))
     build_time = time.perf_counter() - t0
+    # an algo that shares a cached build reports the real build cost: on a
+    # cache hit the wall time covers only the variant extras (dtype cast /
+    # VPQ compress), so add the shared graph-build cost back; on a miss
+    # the wall time already includes it
+    if getattr(algo, "_cache_hit", False):
+        build_time += getattr(algo, "shared_build_s", 0.0)
 
     queries = jnp.asarray(ds.queries)
     nq = ds.queries.shape[0]
@@ -558,12 +575,18 @@ def run_config(
                 "search_params": [{...}, ...]}, ...]}."""
     results = []
     for spec in config["algos"]:
-        results.extend(
-            run_case(
-                ds, spec["name"], spec.get("build_param", {}),
-                spec.get("search_params", [{}]), k=k, res=res,
-            )
+        rs = run_case(
+            ds, spec["name"], spec.get("build_param", {}),
+            spec.get("search_params", [{}]), k=k, res=res,
         )
+        # conf-translated entries carry the upstream entry name (e.g.
+        # "raft_ivf_pq.d96b5n50K"); record it so several entries mapping
+        # to one engine stay distinguishable in artifacts
+        label = spec.get("label")
+        if label:
+            for r in rs:
+                r.algo = label
+        results.extend(rs)
     return results
 
 
