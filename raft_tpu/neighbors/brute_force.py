@@ -236,6 +236,102 @@ def search(
     return knn(index.dataset, queries, k, metric=index.metric, res=res)
 
 
+class Batch:
+    """One batch of a :class:`BatchKQuery`: neighbors
+    ``[offset, offset+size)`` for every query, sorted by distance."""
+
+    def __init__(self, distances: jax.Array, indices: jax.Array, offset: int):
+        self._distances = distances
+        self._indices = indices
+        self.offset = offset
+
+    def distances(self) -> jax.Array:
+        return self._distances
+
+    def indices(self) -> jax.Array:
+        return self._indices
+
+    @property
+    def size(self) -> int:
+        return self._indices.shape[1]
+
+
+class BatchKQuery:
+    """Incremental-k queries over a brute-force index: iterate each
+    query's neighbor list in batches of ``batch_size`` — batch 0 is the
+    nearest ``batch_size`` neighbors, batch 1 the next ``batch_size``,
+    and so on, without deciding a final k up front.
+
+    (ref: neighbors/brute_force.cuh:31-70 ``make_batch_k_query`` +
+    detail/knn_brute_force_batch_k_query.cuh ``gpu_batch_k_query``.)
+    The reference caches a device result matrix and grows the searched k
+    exponentially when iteration passes the cached range; here the cached
+    state is the jitted tiled-kNN result at the grown k, so stepping
+    through b batches costs O(log b) searches, each a cache-hit
+    compile.  Batches past the cached k re-search with
+    ``k = max(2*cached, offset+size)`` — the reference's doubling rule
+    (knn_brute_force_batch_k_query.cuh load_batch).
+    """
+
+    def __init__(self, index: Index, queries: jax.Array, batch_size: int,
+                 *, res: Optional[Resources] = None):
+        validation.check_positive(batch_size, "batch_size")
+        self.index = index
+        self.queries = jnp.asarray(queries)
+        self.batch_size = int(batch_size)
+        self._res = res
+        self._cached_k = 0
+        self._vals: Optional[jax.Array] = None
+        self._ids: Optional[jax.Array] = None
+
+    def _ensure(self, upto: int) -> None:
+        upto = min(upto, self.index.size)
+        if upto <= self._cached_k:
+            return
+        want = min(
+            self.index.size,
+            max(upto, 2 * self._cached_k, 2 * self.batch_size),
+        )
+        self._vals, self._ids = search(
+            self.index, self.queries, want, res=self._res
+        )
+        self._cached_k = want
+
+    def batch(self, offset: int, size: int) -> Batch:
+        """Neighbors ``[offset, offset+size)`` for every query (clamped at
+        the index size)."""
+        validation.expects(offset >= 0, f"offset must be >= 0, got {offset}")
+        size = max(0, min(size, self.index.size - offset))
+        if size == 0:  # beyond the index (or size<=0): empty batch, no
+            n_q = self.queries.shape[0]  # search and no None deref
+            return Batch(jnp.zeros((n_q, 0), jnp.float32),
+                         jnp.zeros((n_q, 0), jnp.int32), offset)
+        self._ensure(offset + size)
+        return Batch(
+            self._vals[:, offset:offset + size],
+            self._ids[:, offset:offset + size],
+            offset,
+        )
+
+    def __iter__(self):
+        offset = 0
+        while offset < self.index.size:
+            b = self.batch(offset, self.batch_size)
+            yield b
+            offset += b.size
+
+
+def make_batch_k_query(
+    index: Index,
+    queries: jax.Array,
+    batch_size: int,
+    *,
+    res: Optional[Resources] = None,
+) -> BatchKQuery:
+    """(ref: neighbors/brute_force.cuh:70 ``make_batch_k_query``)"""
+    return BatchKQuery(index, queries, batch_size, res=res)
+
+
 def save(filename: str, index: Index) -> None:
     """(ref: brute_force serialize — version-stamped, SURVEY §5 checkpoint)"""
     ser.save_tree(

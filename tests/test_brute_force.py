@@ -73,3 +73,51 @@ def test_recall_metric(rng):
     _, gt = numpy_knn(x, q, 10)
     r = float(neighborhood_recall(np.asarray(idx), gt))
     assert r == pytest.approx(1.0)
+
+
+def test_batch_k_query_iteration(rng):
+    """Incremental-k batches concatenate to the full sorted neighbor list
+    (ref: knn_brute_force_batch_k_query.cuh semantics — batch 0 is the
+    nearest batch_size, batch 1 the next, ...)."""
+    x = rng.random((230, 12)).astype(np.float32)
+    q = rng.random((7, 12)).astype(np.float32)
+    index = brute_force.build(x)
+    query = brute_force.make_batch_k_query(index, q, 32)
+    got_i, got_d, offsets = [], [], []
+    for batch in query:
+        offsets.append(batch.offset)
+        got_i.append(np.asarray(batch.indices()))
+        got_d.append(np.asarray(batch.distances()))
+    # covers the whole index in batch_size steps (last batch clamped)
+    assert offsets == list(range(0, 230, 32))
+    assert [b.shape[1] for b in got_i] == [32] * 7 + [6]
+    all_i = np.concatenate(got_i, axis=1)
+    all_d = np.concatenate(got_d, axis=1)
+    want_d, want_i = numpy_knn(x, q, 230)
+    # distances are the full sorted list; ids compared by distance (f32
+    # tie order vs the f64 reference), same policy as test_knn_exact
+    np.testing.assert_allclose(all_d, want_d, rtol=1e-4, atol=1e-4)
+    take = np.take_along_axis  # recompute distances at the returned ids
+    d_at_got = np.linalg.norm(
+        x[all_i].astype(np.float64) - q[:, None, :], axis=-1) ** 2
+    np.testing.assert_allclose(d_at_got, want_d, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_k_query_random_access_and_growth(rng):
+    """Explicit batch(offset, size) works without iterating, re-searches
+    only when passing the cached k (the reference's doubling rule)."""
+    x = rng.random((400, 8)).astype(np.float32)
+    q = rng.random((3, 8)).astype(np.float32)
+    index = brute_force.build(x, metric="euclidean")
+    query = brute_force.make_batch_k_query(index, q, 10)
+    b = query.batch(0, 10)
+    assert query._cached_k == 20  # doubled up front
+    b2 = query.batch(10, 10)
+    assert b2.offset == 10 and b2.size == 10
+    want_d, want_i = numpy_knn(x, q, 40, metric="euclidean")
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(b.distances()), np.asarray(b2.distances())], axis=1),
+        want_d[:, :20], rtol=1e-4, atol=1e-4)
+    # clamping at the end of the index
+    tail = query.batch(395, 10)
+    assert tail.size == 5
