@@ -1,0 +1,129 @@
+/* Stable C ABI of the raft_tpu native core — the raft_runtime role
+ * (ref: cpp/include/raft_runtime/: non-templated symbols any language
+ * can bind).  Everything here is implemented in src/{c_api,algorithms,
+ * serialize,hnsw,ann_index}.cc and exported from libraft_tpu_core.so;
+ * raft_tpu/core/native.py binds the same symbols with ctypes.
+ *
+ * Conventions: functions return 0 on success, 1 on error (message via
+ * the matching *_last_error()); builders return NULL on error.  Metric
+ * codes: 0 sqeuclidean, 1 euclidean, 2 inner_product, 3 cosine.
+ * n_threads <= 0 means hardware concurrency. */
+#ifndef RAFT_TPU_C_API_H
+#define RAFT_TPU_C_API_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- resources / workspace (src/c_api.cc; ref: raft::resources) ---- */
+const char* rt_last_error(void);
+void* rt_resources_create(size_t workspace_limit_bytes);
+void rt_resources_destroy(void* res);
+void* rt_resources_copy(void* res);
+void* rt_workspace_alloc(void* res, size_t bytes);
+int rt_workspace_free(void* res, void* p);
+size_t rt_workspace_used(void* res);
+size_t rt_workspace_high_water(void* res);
+
+/* ---- logging (ref: raft/core/logger.hpp) ---- */
+typedef void (*rt_log_callback_t)(int level, const char* msg, void* user);
+void rt_log_set_level(int level);
+int rt_log_get_level(void);
+void rt_log_set_callback(rt_log_callback_t cb, void* user);
+void rt_log(int level, const char* msg);
+
+/* ---- .npy serialization (ref: raft/core/serialize.hpp) ---- */
+int rt_npy_write(const char* path, const void* data, const int64_t* shape,
+                 int rank, const char* dtype);
+int rt_npy_read_info(const char* path, int64_t* shape_out, int* rank_out,
+                     char* dtype_out, size_t dtype_cap);
+int rt_npy_read(const char* path, void* data_out, size_t bytes);
+
+/* ---- interruptible (ref: raft/core/interruptible.hpp) ---- */
+void* rt_interruptible_token(void);
+void rt_interruptible_cancel(void* tok);
+int rt_interruptible_cancelled(void* tok);
+int rt_interruptible_check(void* tok);
+
+/* ---- host algorithm primitives (src/algorithms.cc) ---- */
+const char* rt_alg_last_error(void);
+int rt_refine_host(const float* dataset, int64_t n, int64_t d,
+                   const float* queries, int64_t n_q,
+                   const int32_t* candidates, int64_t k_cand, int64_t k,
+                   int metric, float* out_d, int32_t* out_i, int n_threads);
+int rt_knn_host(const float* dataset, int64_t n, int64_t d,
+                const float* queries, int64_t n_q, int64_t k, int metric,
+                float* out_d, int32_t* out_i, int n_threads);
+int rt_select_k_host(const float* scores, int64_t rows, int64_t cols,
+                     int64_t k, int select_min, float* out_v, int32_t* out_i,
+                     int n_threads);
+int rt_pack_list_layout(const int64_t* labels, int64_t n, int64_t n_lists,
+                        int64_t max_cap, int32_t* slot_out, int64_t* list_out,
+                        int64_t* center_map, int64_t max_out_lists,
+                        int64_t* n_lists_out, int64_t* cap_out);
+int rt_pairwise_distance_host(const float* x, int64_t m, const float* y,
+                              int64_t n, int64_t d, int metric, float* out);
+int rt_kmeans_fit_host(const float* x, int64_t n, int64_t d, int64_t k,
+                       int n_iters, float* centers_inout, int32_t* labels_out,
+                       float* inertia_out, int n_threads);
+int rt_rmat_host(int r_scale, int c_scale, int64_t n_edges, float theta_a,
+                 float theta_b, float theta_c, uint64_t seed,
+                 int64_t* rows_out, int64_t* cols_out);
+
+/* ---- ANN indexes (src/ann_index.cc; ref: raft_runtime/neighbors/
+ * ivf_flat.hpp, ivf_pq.hpp:32-92, cagra.hpp:30-80,
+ * eps_neighborhood.hpp).  One opaque handle type covers all kinds;
+ * rt_ann_serialize/rt_ann_deserialize round-trip any of them. ---- */
+const char* rt_ann_last_error(void);
+void rt_ann_index_destroy(void* index);
+/* kind: 0 ivf_flat, 1 ivf_pq, 2 cagra; extra: n_lists or graph degree */
+int rt_ann_index_info(const void* index, int64_t* kind, int64_t* n,
+                      int64_t* d, int64_t* extra);
+
+void* rt_ivf_flat_build(const float* dataset, int64_t n, int64_t d,
+                        int64_t n_lists, int metric, int kmeans_iters,
+                        int n_threads);
+int rt_ivf_flat_search(const void* index, const float* queries, int64_t n_q,
+                       int64_t n_probes, int64_t k, float* out_d,
+                       int32_t* out_i, int n_threads);
+
+void* rt_ivf_pq_build(const float* dataset, int64_t n, int64_t d,
+                      int64_t n_lists, int64_t pq_dim, int metric,
+                      int kmeans_iters, int n_threads);
+int rt_ivf_pq_search(const void* index, const float* queries, int64_t n_q,
+                     int64_t n_probes, int64_t k, float* out_d,
+                     int32_t* out_i, int n_threads);
+
+void* rt_cagra_build(const float* dataset, int64_t n, int64_t d,
+                     int64_t graph_degree, int metric, int n_threads);
+int rt_cagra_search(const void* index, const float* queries, int64_t n_q,
+                    int64_t itopk, int64_t k, float* out_d, int32_t* out_i,
+                    int n_threads);
+
+int rt_ann_serialize(const void* index, const char* path);
+void* rt_ann_deserialize(const char* path);
+
+int rt_eps_neighbors_host(const float* dataset, int64_t n, int64_t d,
+                          const float* queries, int64_t n_q, float eps_sq,
+                          uint8_t* adj_out, int64_t* vd_out, int n_threads);
+
+/* ---- hnswlib-format engine (src/hnsw.cc; ref: the hnswlib role of
+ * bench/ann/src/hnswlib/hnswlib_wrapper.h) ---- */
+const char* rt_hnsw_last_error(void);
+int rt_hnsw_load(const char* path, int64_t dim, void** out_handle);
+int rt_hnsw_info(void* index, int64_t* n_out, int64_t* dim_out,
+                 int64_t* max_m0_out, int32_t* max_level_out,
+                 int32_t* entrypoint_out);
+int rt_hnsw_search(void* index, const float* queries, int64_t n_q,
+                   int64_t k, int64_t ef, int64_t n_seeds, int metric,
+                   float* out_d, int64_t* out_i, int64_t n_threads);
+void rt_hnsw_free(void* index);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* RAFT_TPU_C_API_H */

@@ -1,0 +1,214 @@
+// Round-trip tests for the ANN-index C ABI: build and search every index
+// kind purely through raft_tpu/c_api.h (VERDICT r4 next #6 — the
+// raft_runtime/neighbors role).  Compiles the engine sources directly so
+// the test needs no .so on the path; asserts recall against the exact
+// rt_knn_host groundtruth and bit-identical results across
+// serialize/deserialize.
+#include "raft_tpu/c_api.h"
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <vector>
+
+namespace {
+
+int g_checks = 0;
+
+void check(bool ok, const char* what) {
+  ++g_checks;
+  if (!ok) {
+    std::fprintf(stderr, "FAIL: %s (ann error: %s)\n", what,
+                 rt_ann_last_error());
+    std::exit(1);
+  }
+}
+
+// clustered blobs — the recall tests need structure, not uniform noise
+void make_blobs(std::vector<float>& x, int64_t n, int64_t d, int n_clusters,
+                unsigned seed) {
+  std::mt19937 rng(seed);
+  std::normal_distribution<float> gauss(0.f, 1.f);
+  std::vector<float> centers(static_cast<size_t>(n_clusters) * d);
+  for (auto& v : centers) v = gauss(rng) * 4.f;
+  x.resize(static_cast<size_t>(n) * d);
+  std::uniform_int_distribution<int> pick(0, n_clusters - 1);
+  for (int64_t i = 0; i < n; ++i) {
+    int c = pick(rng);
+    for (int64_t j = 0; j < d; ++j)
+      x[i * d + j] = centers[static_cast<int64_t>(c) * d + j] + gauss(rng) * 0.6f;
+  }
+}
+
+// fraction of `want`'s top-k found anywhere in got's rows (row stride
+// got_w >= k lets the same helper score wider candidate pools)
+double recall_at_k(const std::vector<int32_t>& got,
+                   const std::vector<int32_t>& want, int64_t n_q, int64_t k,
+                   int64_t got_w = 0) {
+  if (got_w == 0) got_w = k;
+  int64_t hit = 0;
+  for (int64_t q = 0; q < n_q; ++q)
+    for (int64_t m = 0; m < k; ++m)
+      for (int64_t j = 0; j < got_w; ++j)
+        if (got[q * got_w + j] == want[q * k + m]) {
+          ++hit;
+          break;
+        }
+  return static_cast<double>(hit) / static_cast<double>(n_q * k);
+}
+
+}  // namespace
+
+int main() {
+  const int64_t n = 6000, d = 32, n_q = 64, k = 10;
+  std::vector<float> x, q;
+  make_blobs(x, n, d, 64, 0);
+  make_blobs(q, n_q, d, 64, 0);  // same cluster geometry as the base
+
+  for (int metric : {0 /*sqeuclidean*/, 2 /*inner_product*/}) {
+    std::vector<float> gt_d(n_q * k);
+    std::vector<int32_t> gt_i(n_q * k);
+    check(rt_knn_host(x.data(), n, d, q.data(), n_q, k, metric, gt_d.data(),
+                      gt_i.data(), 0) == 0,
+          "groundtruth knn");
+
+    // ---- IVF-Flat: all-lists probe is exact; few probes stay high ----
+    void* flat = rt_ivf_flat_build(x.data(), n, d, 64, metric, 10, 0);
+    check(flat != nullptr, "ivf_flat build");
+    int64_t kind = -1, in = 0, id_ = 0, extra = 0;
+    check(rt_ann_index_info(flat, &kind, &in, &id_, &extra) == 0 &&
+              kind == 0 && in == n && id_ == d && extra == 64,
+          "ivf_flat info");
+    std::vector<float> fd(n_q * k);
+    std::vector<int32_t> fi(n_q * k);
+    check(rt_ivf_flat_search(flat, q.data(), n_q, 64, k, fd.data(), fi.data(),
+                             0) == 0,
+          "ivf_flat search all lists");
+    check(recall_at_k(fi, gt_i, n_q, k) >= 0.999,
+          "ivf_flat exact when probing all lists");
+    check(rt_ivf_flat_search(flat, q.data(), n_q, 8, k, fd.data(), fi.data(),
+                             0) == 0,
+          "ivf_flat search 8 probes");
+    check(recall_at_k(fi, gt_i, n_q, k) >= 0.9, "ivf_flat recall@8probes");
+
+    // serialize round trip: bit-identical results
+    const char* fpath = "/tmp/rt_ann_flat.bin";
+    check(rt_ann_serialize(flat, fpath) == 0, "ivf_flat serialize");
+    void* flat2 = rt_ann_deserialize(fpath);
+    check(flat2 != nullptr, "ivf_flat deserialize");
+    std::vector<float> fd2(n_q * k);
+    std::vector<int32_t> fi2(n_q * k);
+    check(rt_ivf_flat_search(flat2, q.data(), n_q, 8, k, fd2.data(),
+                             fi2.data(), 0) == 0,
+          "ivf_flat search after load");
+    check(std::memcmp(fi.data(), fi2.data(), sizeof(int32_t) * fi.size()) == 0,
+          "ivf_flat ids identical after round trip");
+    check(std::memcmp(fd.data(), fd2.data(), sizeof(float) * fd.size()) == 0,
+          "ivf_flat dists identical after round trip");
+    rt_ann_index_destroy(flat);
+    rt_ann_index_destroy(flat2);
+
+    // ---- IVF-PQ: ADC candidates + exact refine (the reference's
+    // standard recipe — ADC alone shuffles ranks inside concentrated
+    // clusters, refine recovers them; cagra_build.cuh:146-196) ----
+    void* pq = rt_ivf_pq_build(x.data(), n, d, 64, /*pq_dim=*/8, metric, 10, 0);
+    check(pq != nullptr, "ivf_pq build");
+    const int64_t k_cand = 10 * k;
+    std::vector<float> cand_d(n_q * k_cand);
+    std::vector<int32_t> cand_i(n_q * k_cand);
+    check(rt_ivf_pq_search(pq, q.data(), n_q, 32, k_cand, cand_d.data(),
+                           cand_i.data(), 0) == 0,
+          "ivf_pq search");
+    check(recall_at_k(cand_i, gt_i, n_q, k, k_cand) >= 0.8,
+          "ivf_pq candidate pool holds the true neighbors");
+    std::vector<float> pd(n_q * k);
+    std::vector<int32_t> pi(n_q * k);
+    check(rt_refine_host(x.data(), n, d, q.data(), n_q, cand_i.data(),
+                         k_cand, k, metric, pd.data(), pi.data(), 0) == 0,
+          "ivf_pq refine");
+    check(recall_at_k(pi, gt_i, n_q, k) >= 0.9, "ivf_pq refined recall");
+    const char* ppath = "/tmp/rt_ann_pq.bin";
+    check(rt_ann_serialize(pq, ppath) == 0, "ivf_pq serialize");
+    void* pq2 = rt_ann_deserialize(ppath);
+    check(pq2 != nullptr, "ivf_pq deserialize");
+    std::vector<float> pcd2(n_q * k_cand);
+    std::vector<int32_t> pci2(n_q * k_cand);
+    check(rt_ivf_pq_search(pq2, q.data(), n_q, 32, k_cand, pcd2.data(),
+                           pci2.data(), 0) == 0,
+          "ivf_pq search after load");
+    check(std::memcmp(cand_i.data(), pci2.data(),
+                      sizeof(int32_t) * cand_i.size()) == 0,
+          "ivf_pq ids identical after round trip");
+    rt_ann_index_destroy(pq);
+    rt_ann_index_destroy(pq2);
+
+    // ---- CAGRA: graph beam search ----
+    void* cg = rt_cagra_build(x.data(), n, d, /*degree=*/32, metric, 0);
+    check(cg != nullptr, "cagra build");
+    check(rt_ann_index_info(cg, &kind, &in, &id_, &extra) == 0 && kind == 2 &&
+              extra == 32,
+          "cagra info");
+    std::vector<float> cd(n_q * k);
+    std::vector<int32_t> ci(n_q * k);
+    check(rt_cagra_search(cg, q.data(), n_q, /*itopk=*/64, k, cd.data(),
+                          ci.data(), 0) == 0,
+          "cagra search");
+    check(recall_at_k(ci, gt_i, n_q, k) >= 0.9, "cagra recall@itopk64");
+    const char* cpath = "/tmp/rt_ann_cagra.bin";
+    check(rt_ann_serialize(cg, cpath) == 0, "cagra serialize");
+    void* cg2 = rt_ann_deserialize(cpath);
+    check(cg2 != nullptr, "cagra deserialize");
+    std::vector<float> cd2(n_q * k);
+    std::vector<int32_t> ci2(n_q * k);
+    check(rt_cagra_search(cg2, q.data(), n_q, 64, k, cd2.data(), ci2.data(),
+                          0) == 0,
+          "cagra search after load");
+    check(std::memcmp(ci.data(), ci2.data(), sizeof(int32_t) * ci.size()) == 0,
+          "cagra ids identical after round trip");
+    rt_ann_index_destroy(cg);
+    rt_ann_index_destroy(cg2);
+  }
+
+  // ---- epsilon neighborhood vs a brute count ----
+  {
+    const float eps_sq = 4.0f;
+    std::vector<uint8_t> adj(static_cast<size_t>(n_q) * n);
+    std::vector<int64_t> vd(n_q);
+    check(rt_eps_neighbors_host(x.data(), n, d, q.data(), n_q, eps_sq,
+                                adj.data(), vd.data(), 0) == 0,
+          "eps_neighbors");
+    for (int64_t qi = 0; qi < 4; ++qi) {  // spot-check degree consistency
+      int64_t deg = 0;
+      for (int64_t r = 0; r < n; ++r) {
+        float acc = 0.f;
+        for (int64_t j = 0; j < d; ++j) {
+          float diff = q[qi * d + j] - x[r * d + j];
+          acc += diff * diff;
+        }
+        bool in = acc <= eps_sq;
+        check(adj[qi * n + r] == (in ? 1 : 0), "eps adjacency bit");
+        deg += in;
+      }
+      check(vd[qi] == deg, "eps vertex degree");
+    }
+  }
+
+  // error paths: wrong-kind search + unreadable file
+  {
+    void* flat = rt_ivf_flat_build(x.data(), 512, d, 8, 0, 4, 1);
+    check(flat != nullptr, "small flat build");
+    float dd[4];
+    int32_t ii[4];
+    check(rt_cagra_search(flat, q.data(), 1, 8, 4, dd, ii, 1) == 1,
+          "kind mismatch rejected");
+    check(rt_ann_deserialize("/nonexistent/nope.bin") == nullptr,
+          "bad path rejected");
+    rt_ann_index_destroy(flat);
+  }
+
+  std::printf("ann_test: all %d checks passed\n", g_checks);
+  return 0;
+}

@@ -245,3 +245,22 @@ def test_native_rmat_host():
     r2, c2 = native.rmat_host(8, 6, 4000, seed=7)
     np.testing.assert_array_equal(r, r2)
     np.testing.assert_array_equal(c, c2)
+
+
+def test_native_ann_round_trip():
+    """ANN-index C ABI round trip: build/search/serialize every index kind
+    purely through c_api.h — the raft_runtime/neighbors role (ref:
+    raft_runtime/neighbors/ivf_pq.hpp:32-92, cagra.hpp:30-80)."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    cpp = os.path.join(os.path.dirname(os.path.dirname(__file__)), "cpp")
+    out = subprocess.run(
+        ["make", "-C", cpp, "check-ann"], capture_output=True, text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "checks passed" in out.stdout
