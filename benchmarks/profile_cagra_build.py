@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Stage-level wall-clock profile of cagra.build (VERDICT r4 next #4).
+
+Times each build stage separately — ivf_pq knn-graph source (build /
+search-all-rows / refine) and finalize (optimize prune+reverse+merge,
+entry table) — so the 196s-at-100k on-chip build cost can be attributed
+and the dominant stage batched harder.
+
+    python benchmarks/profile_cagra_build.py --n 50000 [--platform cpu]
+
+Prints one JSON line per stage and a total.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=50_000)
+    ap.add_argument("--dim", type=int, default=96)
+    ap.add_argument("--platform", default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from raft_tpu.core.resources import ensure
+    from raft_tpu.neighbors import cagra, ivf_pq, refine
+
+    rng = np.random.default_rng(0)
+    n, d = args.n, args.dim
+    centers = rng.standard_normal((1024, d)).astype(np.float32) * 4.0
+    asg = rng.integers(0, 1024, n)
+    x = jnp.asarray(centers[asg] + rng.standard_normal((n, d)).astype(np.float32) * 0.6)
+    jax.block_until_ready(x)
+
+    res = ensure(None)
+    params = cagra.IndexParams()
+    inter = min(params.intermediate_graph_degree, n - 1)
+    degree = min(params.graph_degree, inter)
+    stages = {}
+
+    def clock(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        stages[name] = time.perf_counter() - t0
+        print(json.dumps({"stage": name, "s": round(stages[name], 2)}), flush=True)
+        return out
+
+    ip, sp, gpu_top_k = cagra._graph_build_ivf_pq_params(params, n, d)
+    idx = clock("ivf_pq_build", lambda: ivf_pq.build(ip, x, res=res))
+
+    def search_all():
+        qtile = cagra._graph_build_qtile(res, n, d)
+        parts = []
+        for s in range(0, n, qtile):
+            _, ids = ivf_pq.search(sp, idx, x[s : s + qtile], gpu_top_k, res=res)
+            parts.append(ids)
+        return jnp.concatenate(parts)
+
+    cands = clock("search_all_rows", search_all)
+    knn = clock(
+        "refine",
+        lambda: refine(x, x, cands, inter + 1, metric=params.metric, res=res)[1],
+    )
+
+    def drop_self():
+        self_col = knn == jnp.arange(n, dtype=knn.dtype)[:, None]
+        order = jnp.argsort(self_col, axis=1, stable=True)
+        return jnp.take_along_axis(knn, order, axis=1)[:, :inter]
+
+    knn_graph = clock("drop_self", drop_self)
+    graph = clock(
+        "optimize", lambda: cagra.optimize(jnp.asarray(knn_graph, jnp.int32), degree, res=res)
+    )
+    clock(
+        "entry_table",
+        lambda: cagra._build_entry_points(
+            x, cagra._auto_entry_points(n), cagra.DISTANCE_TYPES[params.metric],
+            params.seed, res,
+        ),
+    )
+    total = sum(stages.values())
+    print(json.dumps({"stage": "TOTAL", "s": round(total, 2),
+                      "n": n, "dim": d,
+                      "platform": jax.devices()[0].platform,
+                      "split": {k: round(v / total, 3) for k, v in stages.items()}}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
