@@ -163,8 +163,33 @@ def synthetic(
     if name not in _SYNTH_SHAPES:
         raise ValueError(f"unknown dataset {name}; have {sorted(_SYNTH_SHAPES)}")
     n, d, q, metric = _SYNTH_SHAPES[name]
+    return synthetic_geometry(name, n, d, metric, scale=scale,
+                              n_queries=n_queries, default_queries=q,
+                              seed=seed, clustered=clustered)
+
+
+def synthetic_geometry(
+    name: str,
+    n: int,
+    d: int,
+    metric: str,
+    *,
+    scale: float = 1.0,
+    n_queries: int = 0,
+    default_queries: int = 10_000,
+    seed: int = 0,
+    clustered: bool = True,
+) -> Dataset:
+    """Synthetic workload from explicit geometry — the path conf-driven
+    runs take for datasets whose files are not on disk (the reference
+    confs name e.g. deep-100M/base.1B.fbin; here the published dims and
+    metric reproduce the workload shape).
+
+    An explicit ``n_queries`` wins unclamped (callers like frontier.py
+    request exact query counts); 0 scales ``default_queries`` down with
+    small n."""
     n = max(1000, int(n * scale))
-    q = n_queries or min(q, max(100, n // 100))
+    q = n_queries or min(default_queries, max(100, n // 100))
     rng = np.random.default_rng(seed)
     if clustered:
         # mixture of gaussians — ANN-relevant structure (pure uniform data
