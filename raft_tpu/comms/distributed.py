@@ -433,11 +433,23 @@ def sharded_ivf_pq_build(
         skel.codebook,
     )
 
-    # 3) assemble — only the compressed stream crosses to the host
+    # 3) assemble — only the compressed stream crosses to the host.
+    # In multi-process SPMD the sharded codes span non-addressable
+    # devices; every process needs the full stream for the (replicated)
+    # assembly, so gather across hosts — for a single process
+    # process_allgather is a plain device→host fetch (caught by the
+    # 2-process n=100k suite, round 5).
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils as _mh
+
+        codes_np = _mh.process_allgather(codes, tiled=True)
+        labels_np = _mh.process_allgather(labels, tiled=True)
+    else:
+        codes_np, labels_np = np.asarray(codes), np.asarray(labels)
     return ivf_pq._extend_encoded(
         skel,
-        np.asarray(codes)[:n],
-        np.asarray(labels)[:n],
+        codes_np[:n],
+        labels_np[:n],
         jnp.arange(n, dtype=jnp.int32),
     )
 
@@ -765,7 +777,15 @@ def sharded_cagra_build(
     xs = jax.device_put(stack, NamedSharding(mesh, P(axis, None, None)))
     ks = jax.device_put(keys, NamedSharding(mesh, P(axis, None)))
     gi_all, gd_all = f(xs, ks)
-    gi_np, gd_np = np.asarray(gi_all), np.asarray(gd_all)
+    if jax.process_count() > 1:
+        # the merged graph is assembled (replicated) on every host; the
+        # per-batch local graphs live on non-addressable devices
+        from jax.experimental import multihost_utils as _mh
+
+        gi_np = _mh.process_allgather(gi_all, tiled=True)
+        gd_np = _mh.process_allgather(gd_all, tiled=True)
+    else:
+        gi_np, gd_np = np.asarray(gi_all), np.asarray(gd_all)
 
     g_ids = np.full((n, k_out), -1, np.int32)
     g_dists = np.full((n, k_out), np.inf, np.float32)
