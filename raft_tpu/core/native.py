@@ -75,7 +75,9 @@ def _load():
             _LIB = False
             return _LIB
         lib = ctypes.CDLL(so)
-        if not hasattr(lib, "rt_alg_last_error"):
+        # probe the NEWEST exported symbol: an old mapping that predates
+        # any entry bound below must degrade, not AttributeError mid-_load
+        if not hasattr(lib, "rt_eps_neighbors_host"):
             # stale prebuilt library from before the algorithm entry points
             # existed. Rebuild for the *next* process (re-CDLL'ing the same
             # path in this one would hit the loader's pathname cache and
@@ -188,6 +190,49 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.rt_hnsw_free.argtypes = [ctypes.c_void_p]
+        # ANN-index C ABI (ref: raft_runtime/neighbors/*.hpp span)
+        lib.rt_ann_last_error.restype = ctypes.c_char_p
+        lib.rt_ann_index_destroy.argtypes = [ctypes.c_void_p]
+        lib.rt_ann_index_info.restype = ctypes.c_int
+        lib.rt_ann_index_info.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.rt_ivf_flat_build.restype = ctypes.c_void_p
+        lib.rt_ivf_flat_build.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.rt_ivf_flat_search.restype = ctypes.c_int
+        lib.rt_ivf_flat_search.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.rt_ivf_pq_build.restype = ctypes.c_void_p
+        lib.rt_ivf_pq_build.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.rt_ivf_pq_search.restype = ctypes.c_int
+        lib.rt_ivf_pq_search.argtypes = lib.rt_ivf_flat_search.argtypes
+        lib.rt_cagra_build.restype = ctypes.c_void_p
+        lib.rt_cagra_build.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.rt_cagra_search.restype = ctypes.c_int
+        lib.rt_cagra_search.argtypes = lib.rt_ivf_flat_search.argtypes
+        lib.rt_ann_serialize.restype = ctypes.c_int
+        lib.rt_ann_serialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_ann_deserialize.restype = ctypes.c_void_p
+        lib.rt_ann_deserialize.argtypes = [ctypes.c_char_p]
+        lib.rt_eps_neighbors_host.restype = ctypes.c_int
+        lib.rt_eps_neighbors_host.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
         _LIB = lib
         return _LIB
 
@@ -597,3 +642,134 @@ class InterruptibleToken:
         code = _lib().rt_interruptible_check(self._tok)
         if code != 0:
             raise InterruptedError(_lib().rt_last_error().decode())
+
+
+class NativeAnnIndex:
+    """Host ANN index over the stable C ABI (ref: the consumer side of
+    raft_runtime/neighbors/{ivf_flat,ivf_pq,cagra}.hpp).  Build with the
+    ``ivf_flat``/``ivf_pq``/``cagra`` classmethods or :meth:`load`; search
+    returns (distances, ids) numpy arrays.  The native engines are the
+    non-Python half of the ABI — the TPU path stays the JAX package —
+    and double as cross-language semantic checks of the JAX indexes."""
+
+    _KINDS = {0: "ivf_flat", 1: "ivf_pq", 2: "cagra"}
+
+    def __init__(self, handle):
+        if not handle:
+            raise RuntimeError(_lib().rt_ann_last_error().decode())
+        self._h = handle
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def _metric_code(metric: str) -> int:
+        if metric not in _METRIC_CODES:
+            raise ValueError(f"unsupported native ANN metric {metric!r}")
+        return _METRIC_CODES[metric]
+
+    @classmethod
+    def ivf_flat(cls, dataset: np.ndarray, n_lists: int,
+                 metric: str = "sqeuclidean", *, kmeans_iters: int = 10,
+                 n_threads: int = 0) -> "NativeAnnIndex":
+        x = np.ascontiguousarray(dataset, np.float32)
+        return cls(_lib().rt_ivf_flat_build(
+            x.ctypes.data_as(ctypes.c_void_p), x.shape[0], x.shape[1],
+            n_lists, cls._metric_code(metric), kmeans_iters, n_threads))
+
+    @classmethod
+    def ivf_pq(cls, dataset: np.ndarray, n_lists: int, pq_dim: int,
+               metric: str = "sqeuclidean", *, kmeans_iters: int = 10,
+               n_threads: int = 0) -> "NativeAnnIndex":
+        x = np.ascontiguousarray(dataset, np.float32)
+        return cls(_lib().rt_ivf_pq_build(
+            x.ctypes.data_as(ctypes.c_void_p), x.shape[0], x.shape[1],
+            n_lists, pq_dim, cls._metric_code(metric), kmeans_iters, n_threads))
+
+    @classmethod
+    def cagra(cls, dataset: np.ndarray, graph_degree: int = 32,
+              metric: str = "sqeuclidean", *,
+              n_threads: int = 0) -> "NativeAnnIndex":
+        x = np.ascontiguousarray(dataset, np.float32)
+        return cls(_lib().rt_cagra_build(
+            x.ctypes.data_as(ctypes.c_void_p), x.shape[0], x.shape[1],
+            graph_degree, cls._metric_code(metric), n_threads))
+
+    @classmethod
+    def load(cls, path: str) -> "NativeAnnIndex":
+        return cls(_lib().rt_ann_deserialize(path.encode()))
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def info(self) -> dict:
+        kind = ctypes.c_int64()
+        n = ctypes.c_int64()
+        d = ctypes.c_int64()
+        extra = ctypes.c_int64()
+        _lib().rt_ann_index_info(self._h, ctypes.byref(kind), ctypes.byref(n),
+                                 ctypes.byref(d), ctypes.byref(extra))
+        out = {"kind": self._KINDS.get(kind.value, kind.value),
+               "size": n.value, "dim": d.value}
+        out["graph_degree" if kind.value == 2 else "n_lists"] = extra.value
+        return out
+
+    # -- search / persist --------------------------------------------------
+    def search(self, queries: np.ndarray, k: int, *, n_probes: int = 32,
+               itopk: int = 64, n_threads: int = 0):
+        """(dists [q, k] f32, ids [q, k] i32).  ``n_probes`` drives the IVF
+        kinds, ``itopk`` the CAGRA beam."""
+        q = np.ascontiguousarray(queries, np.float32)
+        info = self.info
+        if q.ndim != 2 or q.shape[1] != info["dim"]:
+            raise ValueError(
+                f"queries must be [n_q, {info['dim']}], got {q.shape}")
+        n_q = q.shape[0]
+        out_d = np.empty((n_q, k), np.float32)
+        out_i = np.empty((n_q, k), np.int32)
+        kind = info["kind"]
+        fn = {"ivf_flat": _lib().rt_ivf_flat_search,
+              "ivf_pq": _lib().rt_ivf_pq_search,
+              "cagra": _lib().rt_cagra_search}[kind]
+        knob = itopk if kind == "cagra" else n_probes
+        code = fn(self._h, q.ctypes.data_as(ctypes.c_void_p), n_q, knob, k,
+                  out_d.ctypes.data_as(ctypes.c_void_p),
+                  out_i.ctypes.data_as(ctypes.c_void_p), n_threads)
+        if code != 0:
+            raise RuntimeError(_lib().rt_ann_last_error().decode())
+        return out_d, out_i
+
+    def save(self, path: str) -> None:
+        code = _lib().rt_ann_serialize(self._h, path.encode())
+        if code != 0:
+            raise RuntimeError(_lib().rt_ann_last_error().decode())
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            try:
+                _lib().rt_ann_index_destroy(self._h)
+            except Exception:
+                pass
+
+
+def eps_neighbors_host(dataset: np.ndarray, queries: np.ndarray,
+                       eps: float, *, n_threads: int = 0):
+    """Dense epsilon-neighborhood adjacency + degrees on the host C ABI
+    (ref: raft_runtime/neighbors/eps_neighborhood.hpp).  ``eps`` is the
+    L2 radius (squared internally, matching the reference's eps^2)."""
+    x = np.ascontiguousarray(dataset, np.float32)
+    q = np.ascontiguousarray(queries, np.float32)
+    if x.ndim != 2 or q.ndim != 2 or q.shape[1] != x.shape[1]:
+        raise ValueError(
+            f"dataset/queries must be 2-D with equal dims, got "
+            f"{x.shape} vs {q.shape}")
+    n, n_q = x.shape[0], q.shape[0]
+    adj = np.empty((n_q, n), np.uint8)
+    vd = np.empty(n_q, np.int64)
+    code = _lib().rt_eps_neighbors_host(
+        x.ctypes.data_as(ctypes.c_void_p), n, x.shape[1],
+        q.ctypes.data_as(ctypes.c_void_p), n_q,
+        ctypes.c_float(eps * eps),
+        adj.ctypes.data_as(ctypes.c_void_p),
+        vd.ctypes.data_as(ctypes.c_void_p), n_threads)
+    if code != 0:
+        raise RuntimeError(_lib().rt_ann_last_error().decode())
+    # C writes exactly 0/1 — reinterpret in place, no second dense copy
+    return adj.view(bool), vd

@@ -264,3 +264,53 @@ def test_native_ann_round_trip():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "checks passed" in out.stdout
+
+
+def test_native_ann_python_bindings(rng, tmp_path):
+    """NativeAnnIndex over the ANN C ABI: build/search/save/load from
+    Python, cross-checked against the JAX engines' exact groundtruth —
+    two independent implementations of the same index semantics."""
+    from raft_tpu.core import native
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.stats import neighborhood_recall
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    x = (rng.random((4000, 32)).astype(np.float32) * 4.0)
+    q = x[:50] + 0.01
+    _, gt = brute_force.knn(x, q, 10)
+    gt = np.asarray(gt)
+
+    flat = native.NativeAnnIndex.ivf_flat(x, 32)
+    assert flat.info["kind"] == "ivf_flat" and flat.info["n_lists"] == 32
+    _, fi = flat.search(q, 10, n_probes=32)      # all lists -> exact
+    assert float(neighborhood_recall(fi, gt)) >= 0.999
+
+    pq = native.NativeAnnIndex.ivf_pq(x, 32, pq_dim=8)
+    _, ci = pq.search(q, 100, n_probes=16)       # ADC pool + exact refine
+    _, pi = native.refine_host(x, q, ci, 10)
+    assert float(neighborhood_recall(pi, gt)) >= 0.9
+
+    cg = native.NativeAnnIndex.cagra(x, graph_degree=24)
+    _, gi = cg.search(q, 10, itopk=64)
+    assert float(neighborhood_recall(gi, gt)) >= 0.9
+
+    fn = str(tmp_path / "flat.native.idx")
+    flat.save(fn)
+    flat2 = native.NativeAnnIndex.load(fn)
+    _, fi2 = flat2.search(q, 10, n_probes=32)
+    np.testing.assert_array_equal(fi, fi2)
+
+
+def test_native_eps_neighbors(rng):
+    from raft_tpu.core import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    x = rng.random((500, 8)).astype(np.float32)
+    q = x[:7]
+    eps = 0.6
+    adj, vd = native.eps_neighbors_host(x, q, eps)
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(adj, d2 <= eps * eps)
+    np.testing.assert_array_equal(vd, (d2 <= eps * eps).sum(1))
