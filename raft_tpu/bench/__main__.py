@@ -44,6 +44,45 @@ DEFAULT_CONFIG = {
 }
 
 
+def _conf_dataset(info, args):
+    """Dataset for a conf/yaml-driven run: the registry's on-disk big-ann
+    files when present under --data-dir (memmapped, --scale slices rows),
+    else a synthetic workload with the published geometry."""
+    base_path = os.path.join(args.data_dir, info["base_file"]) \
+        if info.get("base_file") else ""
+    if base_path and os.path.exists(base_path):
+        rows = info.get("subset_size") or None
+        if rows and args.scale < 1.0:
+            rows = max(1000, int(rows * args.scale))
+            print(f"scale={args.scale}: using first {rows} rows of "
+                  f"{info['base_file']}", file=sys.stderr)
+        return datasets.Dataset(
+            name=info["name"],
+            base=datasets.read_bin(base_path, rows=rows, mmap=True),
+            queries=datasets.read_bin(
+                os.path.join(args.data_dir, info["query_file"])),
+            metric=info["metric"],
+        )
+    return datasets.synthetic_geometry(
+        info["name"], info.get("subset_size") or 1_000_000,
+        info["dims"] or 96, info["metric"], scale=args.scale,
+    )
+
+
+def _clamp_n_lists(config, ds):
+    """A scaled-down run keeps the conf's tuning grid but must respect the
+    hard n_lists <= n constraint (a 50K-list deep-100M entry on a 1% smoke
+    has more lists than rows) — clamp sub-sqrt-law and say so."""
+    n_rows = ds.base.shape[0]
+    cap = max(16, int(5 * n_rows**0.5))
+    for a in config["algos"]:
+        nl = a["build_param"].get("n_lists", 0)
+        if nl > cap:
+            print(f"clamped {a.get('label', a['name'])} n_lists "
+                  f"{nl} -> {cap} (n={n_rows})", file=sys.stderr)
+            a["build_param"]["n_lists"] = cap
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("raft_tpu.bench")
     ap.add_argument("--dataset", default="sift-128-euclidean")
@@ -53,6 +92,15 @@ def main(argv=None):
                     "repo's {algos: [...]} shape)")
     ap.add_argument("--conf", default="", help="reference-shaped per-dataset "
                     "conf (run/conf/*.json) — runs unmodified")
+    ap.add_argument("--algo-yaml", default="", help="reference-shaped per-"
+                    "algo tuning grid (run/conf/algos/*.yaml) — cartesian "
+                    "expansion like run/__main__; combine with --group and "
+                    "--datasets-yaml/--dataset")
+    ap.add_argument("--group", default="base",
+                    help="tuning group inside --algo-yaml (base/large/...)")
+    ap.add_argument("--datasets-yaml", default="",
+                    help="reference run/conf/datasets.yaml registry; "
+                    "--dataset then names an entry in it")
     ap.add_argument("--data-dir", default="",
                     help="root for the conf's base_file/query_file paths")
     ap.add_argument("-k", type=int, default=0)
@@ -62,7 +110,45 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     k = args.k or 10
-    if args.conf:
+    if args.algo_yaml:
+        # reference YAML tuning-grid parity (the run/conf/algos/*.yaml
+        # side of VERDICT r4 next #8): cartesian-expand the named group
+        # against the dataset registry (or the built-in geometry table)
+        from raft_tpu.bench import conf as conf_mod
+
+        if args.datasets_yaml:
+            registry = conf_mod.load_datasets_yaml(args.datasets_yaml)
+            if args.dataset not in registry:
+                print(f"{args.dataset!r} not in {args.datasets_yaml}; "
+                      f"have {sorted(registry)}", file=sys.stderr)
+                return 1
+            info = registry[args.dataset]
+        else:
+            dims, metric = conf_mod._REF_DATASET_GEOMETRY.get(
+                args.dataset, (0, "sqeuclidean"))
+            info = {"name": args.dataset, "dims": dims, "metric": metric,
+                    "subset_size": 0, "k": k,
+                    "base_file": "", "query_file": ""}
+        config = conf_mod.load_algo_yaml(
+            args.algo_yaml, group=args.group, dataset_info=info)
+        for note in config.pop("skipped", []):
+            print(f"skipped: {note}", file=sys.stderr)
+        if args.algorithms:
+            # match the expanded label, the engine name, OR the yaml's own
+            # algo name (the label's dot-prefix) — same acceptance as the
+            # --conf path's algo_filter
+            keep = set(args.algorithms.split(","))
+            config["algos"] = [
+                a for a in config["algos"]
+                if a.get("label") in keep or a["name"] in keep
+                or a.get("label", "").split(".")[0] in keep
+            ]
+        if not config["algos"]:
+            print("grid contained no runnable entries", file=sys.stderr)
+            return 1
+        ds = _conf_dataset(info, args)
+        _clamp_n_lists(config, ds)
+    elif args.conf:
         # reference conf-file parity (VERDICT r4 next #8): translate the
         # upstream JSON (dataset section + per-algo tuning grids) and run it
         from raft_tpu.bench import conf as conf_mod
@@ -77,42 +163,8 @@ def main(argv=None):
             print("conf contained no runnable algos", file=sys.stderr)
             return 1
         k = args.k or info["k"]
-        base_path = os.path.join(args.data_dir, info["base_file"]) \
-            if info["base_file"] else ""
-        if base_path and os.path.exists(base_path):
-            # the conf names on-disk big-ann files (fetched via
-            # bench.datasets.get_dataset); subset_size rows stream
-            # memmapped, and --scale shrinks the slice the same way it
-            # shrinks the synthetic fallback (a 0.0002 smoke must not
-            # stream the full 100M base)
-            rows = info["subset_size"] or None
-            if rows and args.scale < 1.0:
-                rows = max(1000, int(rows * args.scale))
-                print(f"scale={args.scale}: using first {rows} rows of "
-                      f"{info['base_file']}", file=sys.stderr)
-            ds = datasets.Dataset(
-                name=info["name"],
-                base=datasets.read_bin(base_path, rows=rows, mmap=True),
-                queries=datasets.read_bin(
-                    os.path.join(args.data_dir, info["query_file"])),
-                metric=info["metric"],
-            )
-        else:
-            ds = datasets.synthetic_geometry(
-                info["name"], info["subset_size"] or 1_000_000,
-                info["dims"], info["metric"], scale=args.scale,
-            )
-        # a scaled-down run keeps the conf's tuning grid but must respect
-        # the hard n_lists <= n constraint (a 50K-list deep-100M entry on
-        # a 1% smoke has more lists than rows) — clamp sub-sqrt-law and say so
-        n_rows = ds.base.shape[0]
-        cap = max(16, int(5 * n_rows**0.5))
-        for a in config["algos"]:
-            nl = a["build_param"].get("n_lists", 0)
-            if nl > cap:
-                print(f"clamped {a.get('label', a['name'])} n_lists "
-                      f"{nl} -> {cap} (n={n_rows})", file=sys.stderr)
-                a["build_param"]["n_lists"] = cap
+        ds = _conf_dataset(info, args)
+        _clamp_n_lists(config, ds)
     else:
         config = (
             json.load(open(args.config)) if args.config else DEFAULT_CONFIG
@@ -130,7 +182,7 @@ def main(argv=None):
     os.makedirs(args.out, exist_ok=True)
     # conf-driven runs label artifacts with the CONF's dataset name, not
     # the unrelated --dataset default
-    out_name = ds.name if args.conf else args.dataset
+    out_name = ds.name if (args.conf or args.algo_yaml) else args.dataset
     base = os.path.join(args.out, f"{out_name}")
     runner.save_results(results, base + ".json")
     export.to_csv(results, base + ".csv")

@@ -211,3 +211,101 @@ def load(path: str, *, algo_filter: Optional[set] = None):
             f"{os.path.basename(path)} is not a reference-shaped conf "
             "(no top-level 'index' list)")
     return translate(conf, algo_filter=algo_filter)
+
+
+# ---- per-algo YAML tuning grids (ref: run/conf/algos/*.yaml + the
+# cartesian expansion of run/__main__.py; constraints modules prune
+# infeasible combos — here the TPU-relevant feasibility rules inline) ----
+
+def _product(grid: Dict[str, list]) -> List[Dict[str, Any]]:
+    keys = sorted(grid)
+    out: List[Dict[str, Any]] = [{}]
+    for key in keys:
+        vals = grid[key]
+        if not isinstance(vals, list):
+            vals = [vals]
+        out = [{**d, key: v} for d in out for v in vals]
+    return out
+
+
+def _build_feasible(algo: str, bp: Dict[str, Any], dims: int, n: int) -> bool:
+    """The role of the reference's constraints module
+    (raft_ann_bench.constraints.raft_ivf_pq_build_constraints: pq_dim
+    bounds vs dims); plus the hard n_lists <= n rule."""
+    if bp.get("nlist", 1) > max(1, n):
+        return False
+    pq_dim = bp.get("pq_dim", bp.get("M", 0))
+    if pq_dim and dims and pq_dim > dims:
+        return False
+    return True
+
+
+def load_algo_yaml(path: str, *, group: str = "base",
+                   dataset_info: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """One algos/*.yaml tuning grid → runner config: the named group's
+    build grid expands to one entry per build combo (cartesian), each
+    carrying the group's expanded search grid — the reference's
+    run/__main__ semantics.  Infeasible combos prune silently (the
+    constraints-module role); the caller's dataset decides dims/n."""
+    import yaml
+
+    with open(path) as fh:
+        doc = yaml.safe_load(fh)
+    name = doc.get("name", "unknown")
+    groups = doc.get("groups", {})
+    if group not in groups:
+        raise ValueError(
+            f"{name} has no group {group!r}; available: {sorted(groups)}")
+    g = groups[group]
+    dims = int((dataset_info or {}).get("dims", 0))
+    n = int((dataset_info or {}).get("subset_size", 0)) or (1 << 62)
+    builds = [bp for bp in _product(g.get("build", {}))
+              if _build_feasible(name, bp, dims, n)]
+    searches = _product(g.get("search", {}))
+    entries = []
+    for bp in builds:
+        label = name + "." + "-".join(
+            f"{k}{bp[k]}" for k in sorted(bp))
+        entries.append({"name": name, "algo": name,
+                        "build_param": bp, "search_params": searches,
+                        "file": label})
+    # reuse the JSON-conf translator for the name/param mapping
+    info = dataset_info or {"name": "unknown", "dims": dims,
+                            "subset_size": 0}
+    conf = {"dataset": {"name": info.get("name", "unknown"),
+                        # carry dims so translate() never depends on the
+                        # built-in geometry table for registry datasets
+                        "dims": dims,
+                        "distance": {"sqeuclidean": "euclidean"}.get(
+                            info.get("metric", ""), info.get("metric", "")),
+            },
+            "search_basic_param": {"k": info.get("k", 10)},
+            "index": [{**e, "name": e["file"]} for e in entries]}
+    _, cfg, skipped = translate(conf)
+    return {"algos": cfg["algos"], "skipped": skipped}
+
+
+def load_datasets_yaml(path: str) -> Dict[str, Dict[str, Any]]:
+    """run/conf/datasets.yaml → {name: dataset_info} (the geometry +
+    file-name registry the reference ships)."""
+    import yaml
+
+    with open(path) as fh:
+        docs = yaml.safe_load(fh)
+    out = {}
+    for d in docs or []:
+        name = d.get("name")
+        if not name:
+            continue
+        out[name] = {
+            "name": name,
+            "dims": int(d.get("dims", 0) or
+                        _REF_DATASET_GEOMETRY.get(name, (0, ""))[0]),
+            "metric": _REF_METRIC.get(d.get("distance", ""), "sqeuclidean"),
+            "subset_size": int(d.get("subset_size", 0)),
+            "base_file": d.get("base_file", ""),
+            "query_file": d.get("query_file", ""),
+            "k": 10,
+        }
+    return out

@@ -43,12 +43,19 @@ def merge_split_lists(centers: np.ndarray, labels: np.ndarray):
 
 
 def default_max_cap(n_rows: int, n_lists: int) -> int:
-    """Per-list capacity bound: 2× the mean occupancy (sublane-rounded).
+    """Per-list capacity bound: a slack factor over the mean occupancy
+    (sublane-rounded).
 
-    Bounds padded-scan waste at ~2× real data per probe in the worst case
-    while leaving room for mild imbalance without splitting."""
+    Padded storage costs ``slack × n_rows × row_bytes`` regardless of the
+    list count, so the slack factor IS the memory multiplier.  2× leaves
+    room for mild imbalance without splitting; at DEEP-100M scale that
+    doubling breaks the one-chip budget (2 × 9.6 GB int8 > 16 GB HBM), and
+    balanced-kmeans lists are even enough that 1.25× plus
+    ``split_oversized_lists`` (which relabels overflow into shard lists —
+    correctness never depends on the slack) is the right trade."""
     mean = max(1, -(-n_rows // max(1, n_lists)))
-    return max(32, round_up(2 * mean, 8))
+    slack_num, slack_den = (5, 4) if n_rows >= 50_000_000 else (2, 1)
+    return max(32, round_up(slack_num * mean // slack_den, 8))
 
 
 def split_oversized_lists(

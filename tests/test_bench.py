@@ -504,3 +504,130 @@ class TestFetchOverHttp:
         assert ds.base.shape == (300, 16)
         assert ds.metric == "sqeuclidean"
         assert ds.gt_neighbors is not None
+
+
+class TestConfTranslation:
+    """Reference conf-file parity (run/conf JSON + algos/*.yaml grids)."""
+
+    _CONF = {
+        "dataset": {"name": "deep-100M", "base_file": "deep-100M/base.1B.fbin",
+                    "subset_size": 100000000,
+                    "query_file": "deep-100M/query.public.10K.fbin",
+                    "distance": "euclidean"},
+        "search_basic_param": {"batch_size": 10000, "k": 10},
+        "index": [
+            {"name": "raft_ivf_pq.d96b5n50K", "algo": "raft_ivf_pq",
+             "build_param": {"nlist": 50000, "pq_dim": 96, "pq_bits": 5,
+                             "ratio": 10, "niter": 25},
+             "file": "x",
+             "search_params": [
+                 {"nprobe": 20, "internalDistanceDtype": "half",
+                  "smemLutDtype": "fp8", "refine_ratio": 2},
+                 {"nprobe": 100, "internalDistanceDtype": "half",
+                  "smemLutDtype": "fp8", "refine_ratio": 2}]},
+            {"name": "faiss_gpu_ivf_flat.nlist50K", "algo": "faiss_gpu_ivf_flat",
+             "build_param": {"nlist": 50000}, "file": "x",
+             "search_params": [{"nprobe": 50}]},
+            {"name": "raft_cagra.dim32", "algo": "raft_cagra",
+             "build_param": {"graph_degree": 32}, "file": "x",
+             "search_params": [{"itopk": 64, "search_width": 2}]},
+            {"name": "hnswlib.M12", "algo": "hnswlib",
+             "build_param": {"M": 12}, "file": "x",
+             "search_params": [{"ef": 10}]},
+        ],
+    }
+
+    def test_translate_json_conf(self):
+        from raft_tpu.bench import conf
+
+        info, cfg, skipped = conf.translate(self._CONF)
+        assert info["name"] == "deep-100M" and info["dims"] == 96
+        assert info["metric"] == "sqeuclidean" and info["k"] == 10
+        by_label = {a["label"]: a for a in cfg["algos"]}
+        pq = by_label["raft_ivf_pq.d96b5n50K"]
+        assert pq["name"] == "raft_tpu_ivf_pq"
+        assert pq["build_param"]["n_lists"] == 50000
+        assert pq["build_param"]["kmeans_trainset_fraction"] == 0.1
+        assert pq["build_param"]["kmeans_n_iters"] == 25
+        assert pq["build_param"]["decoded_dtype"] == "int8"  # fp8 LUT rung
+        assert pq["search_params"] == [
+            {"n_probes": 20, "refine_ratio": 2},
+            {"n_probes": 100, "refine_ratio": 2}]
+        flat = by_label["faiss_gpu_ivf_flat.nlist50K"]
+        assert flat["name"] == "raft_tpu_ivf_flat"
+        assert flat["search_params"] == [{"n_probes": 50}]
+        cag = by_label["raft_cagra.dim32"]
+        assert cag["search_params"] == [{"itopk_size": 64, "search_width": 2}]
+        # hnswlib is skipped with a note, never silently dropped
+        assert any("hnswlib" in s for s in skipped)
+
+    def test_algo_yaml_grid(self, tmp_path):
+        from raft_tpu.bench import conf
+
+        y = tmp_path / "raft_ivf_pq.yaml"
+        y.write_text(
+            "name: raft_ivf_pq\n"
+            "groups:\n"
+            "  base:\n"
+            "    build:\n"
+            "      nlist: [1024, 2048]\n"
+            "      pq_dim: [64, 256]\n"   # 256 > dims -> pruned
+            "      ratio: [10]\n"
+            "    search:\n"
+            "      nprobe: [10, 50]\n"
+            "      smemLutDtype: [\"half\"]\n"
+        )
+        info = {"name": "sift-128-euclidean", "dims": 128,
+                "metric": "sqeuclidean", "subset_size": 1_000_000, "k": 10}
+        cfg = conf.load_algo_yaml(str(y), group="base", dataset_info=info)
+        # 2 nlist x 1 feasible pq_dim (256 pruned by the constraints role)
+        assert len(cfg["algos"]) == 2
+        for a in cfg["algos"]:
+            assert a["name"] == "raft_tpu_ivf_pq"
+            assert a["build_param"]["pq_dim"] == 64
+            assert a["build_param"]["decoded_dtype"] == "bfloat16"
+            assert a["search_params"] == [{"n_probes": 10}, {"n_probes": 50}]
+        with pytest.raises(ValueError):
+            conf.load_algo_yaml(str(y), group="nope", dataset_info=info)
+
+    def test_datasets_yaml(self, tmp_path):
+        from raft_tpu.bench import conf
+
+        y = tmp_path / "datasets.yaml"
+        y.write_text(
+            "- name: deep-1B\n"
+            "  base_file: deep-1B/base.1B.fbin\n"
+            "  query_file: deep-1B/query.public.10K.fbin\n"
+            "  dims: 96\n"
+            "  distance: inner_product\n"
+            "- name: bigann-100M\n"
+            "  base_file: bigann-100M/base.1B.u8bin\n"
+            "  subset_size: 100000000\n"
+            "  dims: 128\n"
+            "  distance: euclidean\n"
+        )
+        reg = conf.load_datasets_yaml(str(y))
+        assert reg["deep-1B"]["metric"] == "inner_product"
+        assert reg["bigann-100M"]["subset_size"] == 100000000
+        assert reg["bigann-100M"]["dims"] == 128
+
+    def test_algo_yaml_custom_registry_dataset(self, tmp_path):
+        """A datasets.yaml entry outside the built-in geometry table must
+        translate via its own dims (review finding, round 5)."""
+        from raft_tpu.bench import conf
+
+        y = tmp_path / "g.yaml"
+        y.write_text(
+            "name: raft_ivf_flat\n"
+            "groups:\n"
+            "  base:\n"
+            "    build:\n"
+            "      nlist: [64]\n"
+            "    search:\n"
+            "      nprobe: [8]\n"
+        )
+        info = {"name": "my-corpus", "dims": 200, "metric": "inner_product",
+                "subset_size": 50_000, "k": 10}
+        cfg = conf.load_algo_yaml(str(y), group="base", dataset_info=info)
+        assert len(cfg["algos"]) == 1
+        assert cfg["algos"][0]["build_param"]["n_lists"] == 64

@@ -49,6 +49,7 @@ def test_docstring_examples(modname):
     [
         ("ann_quickstart.py", ["--n", "3000", "--dim", "32", "--queries", "32"]),
         ("distributed_quickstart.py", ["--devices", "8", "--n", "4000", "--dim", "16"]),
+        ("native_ann_quickstart.py", ["--n", "3000", "--dim", "32", "--queries", "32"]),
     ],
 )
 def test_example_scripts_run(script, argv, monkeypatch):
