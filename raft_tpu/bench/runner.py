@@ -459,12 +459,99 @@ class HnswNativeANN(HnswANN):
         return d, ids.astype(np.int32)
 
 
+class _NativeANN(ANN):
+    """Shared plumbing for the C-ABI engine competitors (cpp/src/
+    ann_index.cc): threaded host C++ with no JAX in build or search —
+    like ``hnsw_native``, genuinely separate codepaths playing the
+    external-CPU-library role faiss-CPU plays in the reference harness."""
+
+    def set_search_param(self, param):
+        self._n_probes = int(param.get("n_probes", 32))
+        self._itopk = int(param.get("itopk_size", 64))
+
+    def search(self, queries, k):
+        return self._index.search(np.asarray(queries, np.float32), k,
+                                  n_probes=self._n_probes, itopk=self._itopk)
+
+    def save(self, path):
+        self._index.save(path)
+
+
+class NativeIvfFlatANN(_NativeANN):
+    name = "native_ivf_flat"
+
+    def build(self, dataset):
+        from raft_tpu.core import native
+
+        x = np.asarray(dataset, np.float32)
+        self._index = native.NativeAnnIndex.ivf_flat(
+            x, n_lists=int(self.build_param.get("n_lists", 256)),
+            metric=self.metric,
+            kmeans_iters=int(self.build_param.get("kmeans_n_iters", 10)))
+        self.set_search_param({})
+
+
+def _divisor_pq_dim(dim: int, want: int) -> int:
+    """Largest divisor of ``dim`` that is <= max(1, want) — the native
+    engine requires dim % pq_dim == 0 (the JAX engine pads instead)."""
+    want = max(1, min(want, dim))
+    for cand in range(want, 0, -1):
+        if dim % cand == 0:
+            return cand
+    return 1
+
+
+class NativeIvfPqANN(_NativeANN):
+    """C-ABI IVF-PQ (ADC LUT scan) + threaded exact host refine — the
+    reference's classic CPU recipe, fully outside JAX."""
+
+    name = "native_ivf_pq"
+
+    def build(self, dataset):
+        from raft_tpu.core import native
+
+        self._x = np.asarray(dataset, np.float32)
+        dim = self._x.shape[1]
+        self._index = native.NativeAnnIndex.ivf_pq(
+            self._x, n_lists=int(self.build_param.get("n_lists", 256)),
+            pq_dim=_divisor_pq_dim(
+                dim, int(self.build_param.get("pq_dim", dim // 4))),
+            metric=self.metric,
+            kmeans_iters=int(self.build_param.get("kmeans_n_iters", 10)))
+        self.set_search_param({})
+
+    def set_search_param(self, param):
+        super().set_search_param(param)
+        self._refine_ratio = int(param.get("refine_ratio", 4))
+
+    def search(self, queries, k):
+        from raft_tpu.core import native
+
+        q = np.asarray(queries, np.float32)
+        _, cand = self._index.search(q, k * self._refine_ratio,
+                                     n_probes=self._n_probes)
+        return native.refine_host(self._x, q, cand, k, metric=self.metric)
+
+
+class NativeCagraANN(_NativeANN):
+    name = "native_cagra"
+
+    def build(self, dataset):
+        from raft_tpu.core import native
+
+        self._index = native.NativeAnnIndex.cagra(
+            np.asarray(dataset, np.float32),
+            graph_degree=int(self.build_param.get("graph_degree", 32)),
+            metric=self.metric)
+        self.set_search_param({})
+
+
 ALGORITHMS = {
     a.name: a
     for a in (
         BruteForceANN, IvfFlatANN, IvfPqANN, CagraANN, CagraVpqANN,
         CagraBf16ANN, BallCoverANN, NumpyExactANN, SklearnANN, HnswANN,
-        HnswNativeANN,
+        HnswNativeANN, NativeIvfFlatANN, NativeIvfPqANN, NativeCagraANN,
     )
 }
 

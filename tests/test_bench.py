@@ -631,3 +631,25 @@ class TestConfTranslation:
         cfg = conf.load_algo_yaml(str(y), group="base", dataset_info=info)
         assert len(cfg["algos"]) == 1
         assert cfg["algos"][0]["build_param"]["n_lists"] == 64
+
+
+def test_native_ann_competitors(ds):
+    """The C-ABI engines bench as standalone competitors (the faiss-CPU
+    role): no JAX in build or search, recall gated vs the dataset's exact
+    groundtruth."""
+    from raft_tpu.core import native as _native
+
+    if not _native.available():
+        pytest.skip("no native toolchain")
+    flat = runner.run_case(
+        ds, "native_ivf_flat", {"n_lists": 32},
+        [{"n_probes": 32}], k=10, warmup=0, iters=1)[0]
+    assert flat.recall >= 0.99  # all lists probed -> exact
+    pq = runner.run_case(
+        ds, "native_ivf_pq", {"n_lists": 32, "pq_dim": 8},
+        [{"n_probes": 16, "refine_ratio": 8}], k=10, warmup=0, iters=1)[0]
+    assert pq.recall >= 0.85
+    cg = runner.run_case(
+        ds, "native_cagra", {"graph_degree": 24},
+        [{"itopk_size": 64}], k=10, warmup=0, iters=1)[0]
+    assert cg.recall >= 0.85
