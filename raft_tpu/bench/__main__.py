@@ -9,6 +9,8 @@ import json
 import os
 import sys
 
+import numpy as np
+
 # platform override must land before any backend is initialized (this image
 # pre-imports jax with the TPU platform forced; jax.config still wins if no
 # backend has been touched yet)
@@ -56,13 +58,30 @@ def _conf_dataset(info, args):
             rows = max(1000, int(rows * args.scale))
             print(f"scale={args.scale}: using first {rows} rows of "
                   f"{info['base_file']}", file=sys.stderr)
-        return datasets.Dataset(
+        ds = datasets.Dataset(
             name=info["name"],
             base=datasets.read_bin(base_path, rows=rows, mmap=True),
             queries=datasets.read_bin(
                 os.path.join(args.data_dir, info["query_file"])),
             metric=info["metric"],
         )
+        # the conf's published groundtruth (ibin) saves the exact-kNN
+        # regeneration — hours at 100M — but only at FULL scale: a row
+        # slice changes the true neighbors
+        gt = info.get("groundtruth_file", "")
+        gt_path = os.path.join(args.data_dir, gt) if gt else ""
+        if gt_path and os.path.exists(gt_path) and rows == (
+                info.get("subset_size") or rows):
+            gt_arr = datasets.read_bin(gt_path, dtype=np.int32)
+            if gt_arr.shape[0] == ds.queries.shape[0]:
+                ds.gt_neighbors = gt_arr
+                print(f"loaded groundtruth from {gt}", file=sys.stderr)
+            else:  # stale/truncated file: regenerate instead of a
+                # broadcast failure (or bogus recall) mid-sweep
+                print(f"groundtruth rows {gt_arr.shape[0]} != queries "
+                      f"{ds.queries.shape[0]}; regenerating",
+                      file=sys.stderr)
+        return ds
     return datasets.synthetic_geometry(
         info["name"], info.get("subset_size") or 1_000_000,
         info["dims"] or 96, info["metric"], scale=args.scale,
@@ -176,7 +195,8 @@ def main(argv=None):
             }
         ds = datasets.synthetic(args.dataset, scale=args.scale)
     args.k = k
-    datasets.generate_groundtruth(ds, k=max(args.k, 100))
+    if ds.gt_neighbors is None or ds.gt_neighbors.shape[1] < args.k:
+        datasets.generate_groundtruth(ds, k=max(args.k, 100))
     results = runner.run_config(ds, config, k=args.k)
 
     os.makedirs(args.out, exist_ok=True)

@@ -155,6 +155,7 @@ def translate(conf: Dict[str, Any], *, algo_filter: Optional[set] = None
             conf.get("search_basic_param", {}).get("batch_size", 10_000)),
         "base_file": ds.get("base_file", ""),
         "query_file": ds.get("query_file", ""),
+        "groundtruth_file": ds.get("groundtruth_neighbors_file", ""),
     }
 
     algos, skipped = [], []
@@ -306,6 +307,7 @@ def load_datasets_yaml(path: str) -> Dict[str, Dict[str, Any]]:
             "subset_size": int(d.get("subset_size", 0)),
             "base_file": d.get("base_file", ""),
             "query_file": d.get("query_file", ""),
+            "groundtruth_file": d.get("groundtruth_neighbors_file", ""),
             "k": 10,
         }
     return out
