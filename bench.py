@@ -143,6 +143,9 @@ def main() -> None:
             sys.exit(2)
         run_leg(sys.argv[idx + 1])
         return
+    if "serve" in sys.argv[1:]:
+        run_serve_leg()
+        return
     if probe_tpu() is not None:
         # verify cache serialization in a subprocess first — an unverified/
         # broken cache must never hang the bench
@@ -381,6 +384,84 @@ def run_leg(leg: str) -> None:
                 "pallas": pallas_used,
                 "build_s": round(build_s, 1),
                 "exact_qps": round(exact_qps, 1),
+                "n": n,
+            }
+        )
+    )
+
+
+def run_serve_leg() -> None:
+    """``python bench.py serve`` — online-serving smoke benchmark (CPU).
+
+    Exercises the raft_tpu.serve stack the way traffic does: a warmed
+    SearchService fed single-query requests from concurrent client
+    threads, micro-batched into pow2 buckets.  Emits one BENCH-compatible
+    JSON line with the serving headline numbers (QPS, p50/p99 request
+    latency, batch-fill ratio) — and the recompile counter, which must
+    read 0 for the line to be meaningful (a non-zero value means the hot
+    path is paying XLA compiles and the throughput number is garbage).
+    """
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu import serve
+    from raft_tpu.neighbors import ivf_flat
+
+    n, d, k = 8192, 64, 10
+    n_requests, n_clients = 512, 4
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_requests, d), dtype=np.float32)
+
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), dataset)
+    svc = serve.SearchService(k=k, max_batch=32, max_delay_ms=0.5)
+    svc.add_index(
+        "bench", serve.MutableIndex(
+            index, search_params=ivf_flat.SearchParams(n_probes=8)
+        ),
+        warmup=True,
+    )
+
+    def client(cid: int):
+        futs = [
+            svc.submit("bench", queries[i])
+            for i in range(cid, n_requests, n_clients)
+        ]
+        for f in futs:
+            f.result(timeout=120)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(c,)) for c in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    svc.stop()
+
+    st = svc.stats("bench")
+    print(
+        json.dumps(
+            {
+                "metric": f"serve_qps_ivf_flat_n{n // 1000}k_k{k}",
+                "value": round(n_requests / wall, 1),
+                "unit": "queries/s",
+                "platform": "cpu",
+                "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+                "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+                "batch_fill": round(st["batch_fill"], 3)
+                if st["batch_fill"] else None,
+                "batches": st["batches"],
+                "recompiles": st["recompiles"],
+                "warmup_compiles": st["warmup_compiles"],
+                "requests": n_requests,
                 "n": n,
             }
         )

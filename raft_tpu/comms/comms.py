@@ -162,7 +162,7 @@ def local_comms(n_devices: Optional[int] = None) -> Comms:
 # Same here: each returns True iff the collective produced the expected value
 # on every shard.
 
-from jax import shard_map as _shard_map  # noqa: E402
+from raft_tpu.core.compat import shard_map as _shard_map  # noqa: E402
 
 
 def _run(comms: Comms, fn, out_specs=P()):

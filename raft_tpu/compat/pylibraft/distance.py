@@ -14,8 +14,12 @@ DISTANCE_TYPES = sorted(_pairwise.DISTANCE_TYPES)
 
 def pairwise_distance(X, Y, metric="euclidean", p=2.0, handle: Optional[DeviceResources] = None):
     res = handle.res if handle else None
+    # preserve X-is-Y through the conversion so the core's exact-diagonal
+    # rule (self-distance is 0) can apply
     out = _pairwise.pairwise_distance(
-        to_device_array(X), to_device_array(Y), metric=metric, p=p, res=res
+        to_device_array(X),
+        None if Y is X else to_device_array(Y),
+        metric=metric, p=p, res=res,
     )
     return convert_output(out)
 

@@ -1,0 +1,69 @@
+"""JAX-version compatibility shims.
+
+The repo targets whatever jaxlib the image bakes in, and the config
+surface moves between releases.  Every shim here follows the same rule:
+try the modern config knob first, fall back to the oldest mechanism that
+still works, and fail loudly only when neither can apply (e.g. the
+backend is already initialized and the setting cannot take effect).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def set_host_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices (multi-device simulation).
+
+    Newer jax exposes this as the ``jax_num_cpu_devices`` config option;
+    older releases (like the 0.4.x line this image ships) only honor the
+    ``--xla_force_host_platform_device_count`` XLA flag, which is read
+    when the CPU backend is created.  Either way this must run before the
+    first backend touch (``jax.devices()``/any dispatch) to take effect.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    # if the CPU backend already exists the flag cannot apply — surface the
+    # mismatch instead of silently running single-device
+    try:
+        backends = jax._src.xla_bridge._backends
+    except Exception:  # pragma: no cover - private API moved
+        backends = {}
+    if backends and len(jax.devices()) != n:
+        raise RuntimeError(
+            f"set_host_device_count({n}) called after backend init; "
+            f"visible devices: {len(jax.devices())}"
+        )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``.
+
+    Modern jax exports it top-level with a ``check_vma`` knob; the 0.4.x
+    line ships it under ``jax.experimental`` where the same knob is named
+    ``check_rep``.  Callers use the modern keyword spelling.
+    """
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _esm
+
+        return _esm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma,
+    )

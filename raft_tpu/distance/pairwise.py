@@ -321,6 +321,7 @@ def pairwise_distance(
     True
     """
     res = ensure(res)
+    x_is_y = y is None or y is x
     x = jnp.asarray(x)
     y = x if y is None else jnp.asarray(y)
     validation.check_in(metric, DISTANCE_TYPES, "metric")
@@ -334,4 +335,11 @@ def pairwise_distance(
     else:
         row_bytes = 4 * n * d  # [tile, n, d] broadcast
     tile_rows = min(max(res.workspace_rows(row_bytes), 8), max(x.shape[0], 1))
-    return _pairwise_jit(x, y, canonical, p, tile_rows)
+    out = _pairwise_jit(x, y, canonical, p, tile_rows)
+    if x_is_y and canonical != "inner_product":
+        # d(x, x) is exactly 0 for every true distance here, but the
+        # expanded ‖x‖²−2x·y+‖y‖² form cancels catastrophically in f32
+        # (the sklearn euclidean_distances X-is-Y rule)
+        diag = jnp.arange(out.shape[0])
+        out = out.at[diag, diag].set(0.0)
+    return out

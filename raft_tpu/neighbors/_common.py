@@ -230,6 +230,29 @@ def sorted_id_dedup(ids: jax.Array):
     return order, dup
 
 
+def resolve_pass_filter(sample_filter, deleted_mask):
+    """Fold an optional tombstone mask into the pass-filter convention.
+
+    ``sample_filter`` keeps set bits (ref: sample_filter_types.hpp
+    bitset_filter); ``deleted_mask`` EXCLUDES set bits (the serving layer's
+    tombstone convention, raft_tpu.serve.mutation).  Returns a single
+    pass-filter Bitset or None.  Both masks must cover the same id space
+    when combined.
+    """
+    from raft_tpu.core.bitset import Bitset
+
+    if deleted_mask is None:
+        return sample_filter
+    if sample_filter is None:
+        return Bitset(~deleted_mask.words, deleted_mask.n_bits)
+    if sample_filter.n_bits != deleted_mask.n_bits:
+        raise ValueError(
+            f"sample_filter covers {sample_filter.n_bits} ids but "
+            f"deleted_mask covers {deleted_mask.n_bits}"
+        )
+    return Bitset(sample_filter.words & ~deleted_mask.words, sample_filter.n_bits)
+
+
 def invalid_mask(ids: jax.Array, filter_words: Optional[jax.Array]) -> jax.Array:
     """Candidate mask: padding slots plus bitset-filtered ids
     (ref: neighbors/sample_filter_types.hpp bitset_filter)."""

@@ -44,6 +44,7 @@ def _tiled_knn(
     tile_cols: int,
     query_tile: int,
     select_min: bool,
+    filter_words: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     n_q, d = queries.shape
     n, _ = dataset.shape
@@ -66,9 +67,20 @@ def _tiled_knn(
             dist = distance_matrix_tile(q, tile, metric, p)
             col_ids = tile_idx * tile_cols + jnp.arange(tile_cols, dtype=jnp.int32)
             dist = jnp.where((col_ids < n)[None, :], dist, worst)
+            sel_ids = jnp.broadcast_to(col_ids[None, :], dist.shape)
+            if filter_words is not None:
+                # post-filter (tombstones / sample filter): excluded rows
+                # take the worst distance and surface as id −1, matching
+                # the IVF family's filtered-candidate contract
+                word = filter_words[jnp.clip(col_ids, 0) // 32]
+                passing = (
+                    (word >> (col_ids % 32).astype(jnp.uint32)) & 1
+                ).astype(bool) & (col_ids < n)
+                dist = jnp.where(passing[None, :], dist, worst)
+                sel_ids = jnp.where(passing[None, :], sel_ids, -1)
             tv, ti = select_k(
                 dist, min(k, tile_cols), select_min=select_min,
-                input_indices=jnp.broadcast_to(col_ids[None, :], dist.shape),
+                input_indices=sel_ids,
             )
             merged = jnp.concatenate([best_v, tv], axis=1)
             merged_i = jnp.concatenate([best_i, ti], axis=1)
@@ -98,6 +110,8 @@ def knn(
     *,
     metric: str = "sqeuclidean",
     p: float = 2.0,
+    sample_filter=None,
+    deleted_mask=None,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN: (distances [n_q, k], indices [n_q, k]).
@@ -105,6 +119,10 @@ def knn(
     (Python ref: pylibraft.neighbors.brute_force.knn — same order of
     returns.) ``inner_product`` selects largest, all distances smallest,
     matching the reference's select-direction logic.
+
+    ``sample_filter`` (pass-bits kept) and ``deleted_mask`` (set bits
+    excluded — the serve layer's tombstone convention) post-filter the
+    candidate set; excluded rows surface as id −1 at the worst distance.
 
     Examples
     --------
@@ -133,6 +151,15 @@ def knn(
     select_min = canonical != "inner_product"
     n, d = dataset.shape
 
+    from raft_tpu.neighbors._common import resolve_pass_filter
+
+    pass_filter = resolve_pass_filter(sample_filter, deleted_mask)
+    if pass_filter is not None and pass_filter.n_bits < n:
+        raise ValueError(
+            f"filter covers {pass_filter.n_bits} ids but dataset has {n} rows"
+        )
+    filter_words = None if pass_filter is None else pass_filter.words
+
     # Pallas fused distance+topk path (ref: the fusedL2Knn fast path,
     # spatial/knn/detail/fused_l2_knn-inl.cuh — fuses the distance tile and
     # selection so the [n_q, n] score matrix never reaches HBM). Opt-in via
@@ -146,6 +173,7 @@ def knn(
         and canonical in ("sqeuclidean", "euclidean", "inner_product")
         and k <= 128
         and canonical_f32
+        and filter_words is None  # the fused kernel has no post-filter leg
     ):
         from raft_tpu.kernels import interpret_mode
         from raft_tpu.kernels.fused_knn import fused_l2_topk
@@ -198,6 +226,7 @@ def knn(
         tile_cols,
         query_tile,
         select_min,
+        filter_words,
     )
     return vals, idx
 
@@ -231,9 +260,14 @@ def search(
     queries: jax.Array,
     k: int,
     *,
+    sample_filter=None,
+    deleted_mask=None,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    return knn(index.dataset, queries, k, metric=index.metric, res=res)
+    return knn(
+        index.dataset, queries, k, metric=index.metric,
+        sample_filter=sample_filter, deleted_mask=deleted_mask, res=res,
+    )
 
 
 class Batch:

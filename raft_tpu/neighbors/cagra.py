@@ -674,6 +674,7 @@ def search(
     k: int,
     *,
     sample_filter: Optional[Bitset] = None,
+    deleted_mask: Optional[Bitset] = None,
     res: Optional[Resources] = None,
     seed_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -683,8 +684,14 @@ def search(
 
     ``seed_ids`` overrides init-candidate generation ([q, s] dataset row
     ids) — the seam the sharded search uses so per-query results are
-    bit-identical regardless of how the query batch is split."""
+    bit-identical regardless of how the query batch is split.
+
+    ``deleted_mask`` excludes set bits (tombstones, raft_tpu.serve) and
+    composes with ``sample_filter`` (pass-bits kept)."""
     res = ensure(res)
+    from raft_tpu.neighbors._common import resolve_pass_filter
+
+    sample_filter = resolve_pass_filter(sample_filter, deleted_mask)
     queries = jnp.asarray(queries, jnp.float32)
     if queries.ndim != 2 or queries.shape[1] != index.dim:
         raise ValueError(f"queries shape {queries.shape} vs index dim {index.dim}")

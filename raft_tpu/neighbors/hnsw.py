@@ -86,9 +86,34 @@ def _build_hierarchy(data: np.ndarray, max_m: int, seed: int,
     return levels, upper
 
 
+def _as_deleted_bools(deleted, n: int) -> Optional[np.ndarray]:
+    """Normalize a tombstone spec (Bitset, bool mask, or id list) to a
+    [n] bool array; None stays None."""
+    if deleted is None:
+        return None
+    from raft_tpu.core.bitset import Bitset
+
+    if isinstance(deleted, Bitset):
+        if deleted.n_bits < n:
+            raise ValueError(
+                f"tombstone mask covers {deleted.n_bits} ids, index has {n}"
+            )
+        words = np.asarray(deleted.words).astype(np.uint32)
+        bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+        return bits[:n].astype(bool)
+    deleted = np.asarray(deleted)
+    if deleted.dtype == bool:
+        if deleted.shape != (n,):
+            raise ValueError(f"bool mask shape {deleted.shape} != ({n},)")
+        return deleted
+    out = np.zeros(n, bool)
+    out[deleted.astype(np.int64)] = True
+    return out
+
+
 def serialize_to_hnswlib(
     filename: str, index: "cagra.Index", *, hierarchy: bool = True,
-    seed: int = 0,
+    seed: int = 0, deleted=None,
 ) -> None:
     """Write a CAGRA index as an hnswlib index file
     (ref: cagra_serialize.cuh serialize_to_hnswlib:96-203).
@@ -97,10 +122,18 @@ def serialize_to_hnswlib(
     export (see :func:`_build_hierarchy`) so single-entry hierarchical
     searchers — stock hnswlib, :func:`load_native` — navigate clustered
     data; ``hierarchy=False`` reproduces the reference exporter's
-    level-0-only layout byte for byte."""
+    level-0-only layout byte for byte.
+
+    ``deleted`` marks elements with hnswlib's delete flag (bit 0x01 of the
+    uint16 flags half of the link-count field — what markDelete() sets), so
+    a serve-layer tombstone mask survives export: stock hnswlib skips the
+    marked elements, and :func:`load` round-trips them back into a
+    :class:`~raft_tpu.core.bitset.Bitset`.  Accepts a Bitset (set bit =
+    deleted, the serve convention), a [n] bool mask, or an id list."""
     data = np.asarray(index.dataset, np.float32)
     graph = np.asarray(index.graph, np.uint32)
     n, dim = data.shape
+    del_bools = _as_deleted_bools(deleted, n)
     deg = graph.shape[1]
     max_m = deg // 2
     if hierarchy:
@@ -134,7 +167,12 @@ def serialize_to_hnswlib(
         block = np.zeros(size_data_per_element, np.uint8)
         for i in range(n):
             off = 0
-            block[0:4] = np.frombuffer(struct.pack("<i", deg), np.uint8)
+            # uint16 link count + uint16 flags (bit 0x01 = deleted), packed
+            # in the same 4 bytes hnswlib uses
+            flags = 1 if del_bools is not None and del_bools[i] else 0
+            block[0:4] = np.frombuffer(
+                struct.pack("<HH", deg, flags), np.uint8
+            )
             block[4 : 4 + deg * 4] = graph[i].view(np.uint8)
             off = 4 + deg * 4
             block[off : off + dim * 4] = data[i].view(np.uint8)
@@ -163,11 +201,19 @@ def serialize_to_hnswlib(
                 fh.write(padded.tobytes())
 
 
-def load(filename: str, dim: int, *, metric: str = "sqeuclidean") -> "cagra.Index":
+def load(
+    filename: str, dim: int, *, metric: str = "sqeuclidean",
+    return_deleted: bool = False,
+):
     """Parse an hnswlib index file's base layer into a searchable index
     (ref: hnsw.hpp from_cagra/deserialize — the inverse wrapper). Elements
     are re-ordered by their stored labels so returned neighbor ids are
-    labels, like hnswlib's knn_query."""
+    labels, like hnswlib's knn_query.
+
+    With ``return_deleted=True`` returns ``(index, deleted_mask)`` where
+    the mask is the file's delete flags as a
+    :class:`~raft_tpu.core.bitset.Bitset` (set bit = deleted — pass it
+    straight to :func:`search`/``cagra.search`` or the serve layer)."""
     with open(filename, "rb") as fh:
         header = fh.read(8 * 6)
         (_, max_el, n, size_per, label_off, offset_data) = struct.unpack(
@@ -188,6 +234,9 @@ def load(filename: str, dim: int, *, metric: str = "sqeuclidean") -> "cagra.Inde
     # upper bytes of the 4-byte field — reading int32 would corrupt counts
     # for marked-deleted elements
     counts = level0[:, 0:2].copy().view(np.uint16)[:, 0].astype(np.int64)
+    # flags half of the packed field: bit 0x01 is hnswlib's delete mark
+    flags = level0[:, 2:4].copy().view(np.uint16)[:, 0]
+    deleted = (flags & 1).astype(bool)
     links = level0[:, 4 : 4 + deg * 4].copy().view(np.uint32).reshape(n, deg)
     data = level0[:, offset_data : offset_data + dim * 4].copy().view(np.float32)
     data = data.reshape(n, dim)
@@ -202,7 +251,12 @@ def load(filename: str, dim: int, *, metric: str = "sqeuclidean") -> "cagra.Inde
     inv[order] = np.arange(n)
     data = data[order]
     links = inv[links.astype(np.int64)][order].astype(np.int32)
-    return cagra.from_graph(metric, jnp.asarray(data), jnp.asarray(links))
+    index = cagra.from_graph(metric, jnp.asarray(data), jnp.asarray(links))
+    if return_deleted:
+        from raft_tpu.core.bitset import Bitset
+
+        return index, Bitset.from_mask(jnp.asarray(deleted[order]))
+    return index
 
 
 def search(
@@ -211,12 +265,19 @@ def search(
     k: int,
     *,
     ef: int = 64,
+    sample_filter=None,
+    deleted_mask=None,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Search an hnsw-loaded (or any CAGRA) index; ``ef`` maps to the beam
-    width (ref: hnsw.hpp search_params{ef})."""
+    width (ref: hnsw.hpp search_params{ef}).  ``deleted_mask`` is the
+    shared tombstone convention (set bit = skip) — e.g. the mask
+    :func:`load` recovers from a file's delete flags."""
     params = cagra.SearchParams(itopk_size=max(ef, k))
-    return cagra.search(params, index, queries, k, res=res)
+    return cagra.search(
+        params, index, queries, k,
+        sample_filter=sample_filter, deleted_mask=deleted_mask, res=res,
+    )
 
 
 def load_native(filename: str, dim: int):

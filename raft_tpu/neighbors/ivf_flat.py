@@ -600,11 +600,18 @@ def search(
     k: int,
     *,
     sample_filter: Optional[Bitset] = None,
+    deleted_mask: Optional[Bitset] = None,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (distances [q, k], indices [q, k]); indices −1 never appear
-    unless a list underfills k (then distance is +inf)."""
+    unless a list underfills k (then distance is +inf).
+
+    ``deleted_mask`` excludes set bits (tombstones, raft_tpu.serve) and
+    composes with ``sample_filter`` (pass-bits kept)."""
     res = ensure(res)
+    from raft_tpu.neighbors._common import resolve_pass_filter
+
+    sample_filter = resolve_pass_filter(sample_filter, deleted_mask)
     queries = jnp.asarray(queries, jnp.float32)
     if queries.ndim != 2 or queries.shape[1] != index.dim:
         raise ValueError(f"queries shape {queries.shape} vs index dim {index.dim}")

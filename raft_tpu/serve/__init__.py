@@ -1,0 +1,50 @@
+"""raft_tpu.serve — online ANN query serving.
+
+The offline library answers "given a batch, search"; this package answers
+the online question: single-query requests arriving over time, against
+indexes that change while being served.  Five pieces:
+
+- :mod:`~raft_tpu.serve.batcher` — dynamic micro-batching into a padded
+  power-of-two bucket ladder, so every request hits an already-compiled
+  executable (zero recompiles after warmup).
+- :mod:`~raft_tpu.serve.mutation` — ``MutableIndex``: tombstone deletes
+  (filtered inside the backend searches) + a brute-force side buffer for
+  upserts, merged through one ``select_k``.
+- :mod:`~raft_tpu.serve.registry` — named, versioned indexes with atomic
+  hot-swap and snapshot/restore.
+- :mod:`~raft_tpu.serve.metrics` — QPS / p50 / p99 / batch-fill and a
+  *real* recompile counter (jax.monitoring backend-compile events).
+- :mod:`~raft_tpu.serve.replica` — query-sharded multi-chip dispatch over
+  a replicated index (comms/ mesh).
+
+``SearchService`` (:mod:`~raft_tpu.serve.service`) assembles them; see
+``docs/serving.md`` for the guided tour.
+"""
+
+from raft_tpu.serve.batcher import MicroBatcher
+from raft_tpu.serve.metrics import (
+    ServingMetrics,
+    compile_count,
+    install_compile_listener,
+)
+from raft_tpu.serve.mutation import MutableIndex
+from raft_tpu.serve.registry import IndexRegistry
+from raft_tpu.serve.replica import (
+    ReplicaGroup,
+    make_replicated_search,
+    replicated_search,
+)
+from raft_tpu.serve.service import SearchService
+
+__all__ = [
+    "IndexRegistry",
+    "MicroBatcher",
+    "MutableIndex",
+    "ReplicaGroup",
+    "SearchService",
+    "ServingMetrics",
+    "compile_count",
+    "install_compile_listener",
+    "make_replicated_search",
+    "replicated_search",
+]

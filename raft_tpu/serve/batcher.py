@@ -1,0 +1,324 @@
+"""Dynamic micro-batcher: coalesce single-query requests into padded,
+power-of-two-bucketed batches.
+
+The serving problem on TPU is that ``jit`` specializes on shapes: a stream
+of requests with 1, 3, 7, 2, ... queries would trigger a fresh XLA compile
+per novel shape.  The batcher fixes the shape universe up front — batches
+are always padded to a bucket from the ladder ``min_bucket, 2*min_bucket,
+..., max_batch`` — and :meth:`MicroBatcher.warmup` runs a dummy batch
+through every bucket so each executable exists *before* traffic arrives.
+After warmup the hot path performs zero compiles, which
+:class:`~raft_tpu.serve.metrics.ServingMetrics` verifies by bracketing
+every dispatch with :func:`~raft_tpu.serve.metrics.compile_count`.
+
+Coalescing policy: the worker thread takes whatever is queued the moment
+it wakes; if the pending rows are below ``max_batch`` it waits up to
+``max_delay_ms`` (measured from the oldest queued request) for stragglers,
+then dispatches.  Latency recorded per request is submit→complete, i.e.
+queue wait is included — that is the number a caller actually experiences.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from raft_tpu.core.trace import trace_range
+from raft_tpu.serve.metrics import ServingMetrics, compile_count
+
+# search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k])
+SearchFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class _Request:
+    __slots__ = ("rows", "future", "t_submit")
+
+    def __init__(self, rows: np.ndarray, future: Future, t_submit: float):
+        self.rows = rows
+        self.future = future
+        self.t_submit = t_submit
+
+
+class MicroBatcher:
+    """Coalesces query requests into pow2-padded batches for a search fn.
+
+    Parameters
+    ----------
+    search_fn:
+        Callable mapping a ``[b, dim]`` float32 query batch to
+        ``(distances [b, k], ids [b, k])``.  It is resolved per *dispatch*,
+        so a registry hot-swap behind the callable takes effect without
+        restarting the batcher (and without recompiles, as shapes are
+        unchanged).
+    dim:
+        Query dimensionality; padded rows are zeros of this width.
+    min_bucket / max_batch:
+        Bucket ladder bounds; both are rounded up to powers of two.
+    max_delay_ms:
+        Max time a request may wait for coalescing before dispatch.
+    metrics:
+        Optional shared :class:`ServingMetrics`; a private one is created
+        otherwise.
+    start:
+        When True (default) the worker thread starts immediately.  Tests
+        use ``start=False`` + :meth:`flush` for deterministic batching.
+    """
+
+    def __init__(
+        self,
+        search_fn: SearchFn,
+        dim: int,
+        *,
+        min_bucket: int = 1,
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+        metrics: Optional[ServingMetrics] = None,
+        start: bool = True,
+    ):
+        if dim <= 0:
+            raise ValueError(f"dim must be positive, got {dim}")
+        if min_bucket <= 0 or max_batch <= 0:
+            raise ValueError("min_bucket and max_batch must be positive")
+        min_bucket = _next_pow2(min_bucket)
+        max_batch = _next_pow2(max_batch)
+        if min_bucket > max_batch:
+            raise ValueError(
+                f"min_bucket={min_bucket} exceeds max_batch={max_batch}"
+            )
+        self._search_fn = search_fn
+        self.dim = int(dim)
+        self.min_bucket = min_bucket
+        self.max_batch = max_batch
+        self.max_delay_s = float(max_delay_ms) * 1e-3
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+
+        self._cond = threading.Condition()
+        self._queue: List[_Request] = []
+        self._stopping = False
+        # one dispatch at a time, shared by worker thread and flush()
+        self._dispatch_lock = threading.Lock()
+        self._warm = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- bucket ladder -------------------------------------------------------
+    def buckets(self) -> List[int]:
+        """The full bucket ladder, ascending."""
+        out, b = [], self.min_bucket
+        while b < self.max_batch:
+            out.append(b)
+            b *= 2
+        out.append(self.max_batch)
+        return out
+
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest bucket holding ``n_rows`` (clamped into the ladder)."""
+        return min(self.max_batch, max(self.min_bucket, _next_pow2(n_rows)))
+
+    # -- lifecycle -----------------------------------------------------------
+    def warmup(self) -> int:
+        """Compile every bucket's executable up front; returns compile count.
+
+        Runs a zero-filled batch through each bucket in the ladder and
+        blocks on the result.  Compiles spent here are booked as
+        ``warmup_compiles`` and the hot-path recompile counter is reset, so
+        any later non-zero ``recompiles`` is a genuine shape leak.
+        """
+        total = 0
+        with self._dispatch_lock, trace_range("serve.warmup"):
+            for b in self.buckets():
+                dummy = np.zeros((b, self.dim), dtype=np.float32)
+                c0 = compile_count()
+                dist, ids = self._search_fn(jax.numpy.asarray(dummy))
+                jax.block_until_ready((dist, ids))
+                total += compile_count() - c0
+        self.metrics.record_warmup(total)
+        self.metrics.reset_hot_path()
+        self._warm = True
+        return total
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._worker, name="raft-tpu-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the worker thread; with ``drain`` pending requests complete
+        first, otherwise they fail with :class:`RuntimeError`."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if drain:
+            self.flush()
+        else:
+            with self._cond:
+                pending, self._queue = self._queue, []
+            for req in pending:
+                req.future.set_exception(
+                    RuntimeError("MicroBatcher stopped before dispatch")
+                )
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, queries) -> Future:
+        """Enqueue one request of shape ``[dim]`` or ``[m, dim]``.
+
+        Returns a future resolving to ``(distances [m, k], ids [m, k])``
+        numpy arrays (the leading axis is squeezed away for 1-D input).
+        """
+        rows = np.asarray(queries, dtype=np.float32)
+        squeeze = rows.ndim == 1
+        if squeeze:
+            rows = rows[None, :]
+        if rows.ndim != 2 or rows.shape[1] != self.dim:
+            raise ValueError(
+                f"expected queries of dim {self.dim}, got shape {rows.shape}"
+            )
+        if rows.shape[0] > self.max_batch:
+            raise ValueError(
+                f"request of {rows.shape[0]} rows exceeds max_batch="
+                f"{self.max_batch}; split it client-side"
+            )
+        fut: Future = Future()
+        if squeeze:
+            inner = fut
+            fut = Future()
+            inner.add_done_callback(
+                lambda f, out=fut: _squeeze_result(f, out)
+            )
+            req = _Request(rows, inner, time.perf_counter())
+        else:
+            req = _Request(rows, fut, time.perf_counter())
+        with self._cond:
+            if self._stopping and (
+                self._thread is None or not self._thread.is_alive()
+            ):
+                # no worker; caller is expected to flush() manually
+                pass
+            self._queue.append(req)
+            self._cond.notify()
+        return fut
+
+    def search(self, queries, timeout: Optional[float] = None):
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        fut = self.submit(queries)
+        if self._thread is None or not self._thread.is_alive():
+            self.flush()
+        return fut.result(timeout=timeout)
+
+    # -- batching core -------------------------------------------------------
+    def flush(self) -> int:
+        """Dispatch everything queued right now; returns batches issued."""
+        n_batches = 0
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return n_batches
+                batch = self._take_batch_locked()
+            self._dispatch(batch)
+            n_batches += 1
+
+    def _take_batch_locked(self) -> List[_Request]:
+        """Pop a prefix of the queue totalling at most max_batch rows."""
+        taken, rows = [], 0
+        while self._queue:
+            nxt = self._queue[0]
+            if taken and rows + nxt.rows.shape[0] > self.max_batch:
+                break
+            taken.append(self._queue.pop(0))
+            rows += nxt.rows.shape[0]
+        return taken
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait()
+                if self._stopping:
+                    return
+                # coalescing window: wait for stragglers, bounded by the
+                # oldest request's deadline
+                deadline = self._queue[0].t_submit + self.max_delay_s
+                while (
+                    sum(r.rows.shape[0] for r in self._queue) < self.max_batch
+                    and not self._stopping
+                ):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                    if not self._queue:
+                        break
+                if not self._queue:
+                    continue
+                batch = self._take_batch_locked()
+            with self._dispatch_lock:
+                self._dispatch_locked(batch)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        with self._dispatch_lock:
+            self._dispatch_locked(batch)
+
+    def _dispatch_locked(self, batch: List[_Request]) -> None:
+        if not batch:
+            return
+        n = sum(r.rows.shape[0] for r in batch)
+        bucket = self.bucket_for(n)
+        padded = np.zeros((bucket, self.dim), dtype=np.float32)
+        off = 0
+        for req in batch:
+            m = req.rows.shape[0]
+            padded[off : off + m] = req.rows
+            off += m
+        try:
+            c0 = compile_count()
+            with trace_range("serve.batch"):
+                dist, ids = self._search_fn(jax.numpy.asarray(padded))
+                jax.block_until_ready((dist, ids))
+            compiles = compile_count() - c0
+            dist = np.asarray(dist)
+            ids = np.asarray(ids)
+        except Exception as exc:  # noqa: BLE001 — fail the waiting futures
+            for req in batch:
+                req.future.set_exception(exc)
+            return
+        done = time.perf_counter()
+        off = 0
+        lats = []
+        for req in batch:
+            m = req.rows.shape[0]
+            req.future.set_result((dist[off : off + m], ids[off : off + m]))
+            off += m
+            lats.append(done - req.t_submit)
+        self.metrics.record_batch(n, bucket, lats, compiles)
+
+
+def _squeeze_result(inner: Future, outer: Future) -> None:
+    exc = inner.exception()
+    if exc is not None:
+        outer.set_exception(exc)
+        return
+    dist, ids = inner.result()
+    outer.set_result((dist[0], ids[0]))

@@ -1,0 +1,140 @@
+"""Serving metrics: QPS, latency percentiles, batch fill, recompiles.
+
+TPU serving lives or dies on shape stability — one stray query shape on the
+hot path triggers an XLA compile measured in *seconds* while the request
+(and everything queued behind it) waits.  The recompile counter here is
+therefore not a proxy: ``jax.monitoring`` emits
+``/jax/core/compile/backend_compile_duration`` exactly once per real
+backend compile and never on executable-cache hits, so the batcher can
+bracket every dispatch with :func:`compile_count` and attribute compiles to
+the serving path.  A non-zero ``recompiles`` after warmup is a bug, and
+``tests/test_serve.py`` pins it at zero.
+
+Latency keeps a bounded reservoir (last ``_RESERVOIR`` request latencies)
+— percentile math stays O(reservoir), not O(uptime).  QPS is measured over
+the same window from completion timestamps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+_RESERVOIR = 4096
+
+# ---- process-wide XLA compile counter -------------------------------------
+
+_compile_count = 0
+_listener_installed = False
+_listener_lock = threading.Lock()
+
+
+def _on_event_duration(name: str, duration: float, **kwargs) -> None:
+    global _compile_count
+    if name == "/jax/core/compile/backend_compile_duration":
+        _compile_count += 1
+
+
+def install_compile_listener() -> None:
+    """Register the jax.monitoring listener (idempotent, process-wide)."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _listener_installed = True
+
+
+def compile_count() -> int:
+    """Total XLA backend compiles observed in this process so far."""
+    install_compile_listener()
+    return _compile_count
+
+
+class ServingMetrics:
+    """Per-service request/batch counters + latency reservoir.
+
+    Thread-safe; the batcher's worker thread records, any thread snapshots.
+    """
+
+    def __init__(self, reservoir: int = _RESERVOIR):
+        self._lock = threading.Lock()
+        self._latencies = deque(maxlen=reservoir)   # seconds, per request
+        self._done_ts = deque(maxlen=reservoir)     # completion timestamps
+        self.requests = 0
+        self.batches = 0
+        self.recompiles = 0        # compiles attributed to serve dispatches
+        self.warmup_compiles = 0   # compiles spent in explicit warmup
+        self._fill_real = 0        # sum of real rows over all batches
+        self._fill_padded = 0      # sum of padded bucket rows
+
+    # -- recording ----------------------------------------------------------
+    def record_batch(
+        self,
+        n_real_rows: int,
+        bucket_rows: int,
+        latencies_s,
+        compiles: int,
+    ) -> None:
+        """One dispatched batch: ``latencies_s`` holds one submit→complete
+        latency per coalesced request (queue wait included)."""
+        now = time.perf_counter()
+        with self._lock:
+            self.requests += len(latencies_s)
+            self.batches += 1
+            self.recompiles += compiles
+            self._fill_real += n_real_rows
+            self._fill_padded += bucket_rows
+            for lat in latencies_s:
+                self._latencies.append(lat)
+                self._done_ts.append(now)
+
+    def record_warmup(self, compiles: int) -> None:
+        with self._lock:
+            self.warmup_compiles += compiles
+
+    def reset_hot_path(self) -> None:
+        """Zero the hot-path recompile attribution (called after warmup)."""
+        with self._lock:
+            self.recompiles = 0
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """One dict with the headline serving numbers (JSON-safe)."""
+        with self._lock:
+            lat = np.asarray(self._latencies, dtype=np.float64)
+            ts = np.asarray(self._done_ts, dtype=np.float64)
+            out: Dict[str, object] = {
+                "requests": self.requests,
+                "batches": self.batches,
+                "recompiles": self.recompiles,
+                "warmup_compiles": self.warmup_compiles,
+                "batch_fill": (
+                    self._fill_real / self._fill_padded
+                    if self._fill_padded
+                    else None
+                ),
+            }
+        if lat.size:
+            out["p50_ms"] = float(np.percentile(lat, 50) * 1e3)
+            out["p99_ms"] = float(np.percentile(lat, 99) * 1e3)
+            span = float(ts.max() - ts.min())
+            # a single instant (or one request) has no measurable rate
+            out["qps"] = float(lat.size / span) if span > 0 else None
+        else:
+            out["p50_ms"] = out["p99_ms"] = out["qps"] = None
+        return out
+
+
+def timed_percentiles(latencies_s, qs=(50, 99)) -> Optional[Dict[str, float]]:
+    """Helper for benches: {'p50_ms': ..., 'p99_ms': ...} or None if empty."""
+    arr = np.asarray(list(latencies_s), dtype=np.float64)
+    if not arr.size:
+        return None
+    return {f"p{q}_ms": float(np.percentile(arr, q) * 1e3) for q in qs}

@@ -1,0 +1,395 @@
+"""Streaming mutation for served indexes: upsert + tombstone delete.
+
+ANN structures (IVF lists, CAGRA graphs) are batch-built; rebuilding per
+write is not an online option.  The serving answer here is the classic
+side-buffer + tombstone design:
+
+* **delete(ids)** flips bits in a tombstone :class:`~raft_tpu.core.bitset.
+  Bitset` over the main index's id space.  Every neighbors backend grew a
+  ``deleted_mask`` argument for exactly this — tombstoned rows are
+  filtered *inside* the main search (surfacing as id −1 at the worst
+  distance), so deletes are visible immediately without touching the
+  built structure.
+* **upsert(vectors)** appends to a host-side growing buffer.  Queries scan
+  the side buffer brute-force (it is small by construction — a background
+  rebuild folds it into the main index; see :meth:`MutableIndex.rebuild`)
+  and the two candidate lists merge through one
+  :func:`~raft_tpu.ops.matrix.select_k`.
+* Upserting an existing id tombstones the old row first, so an id never
+  yields two results.
+
+Shape discipline: the side buffer is padded to a power-of-two capacity
+(occupancy tracked host-side, dead slots masked via the same Bitset
+filter), so the merged search only ever sees O(log growth) distinct side
+shapes — compiles stay off the steady-state hot path.
+
+Thread-safety: mutations and snapshot-taking are guarded by a lock;
+searches run on an immutable snapshot taken under that lock, so a search
+never observes a half-applied mutation (and a hot-swap never tears a
+batch).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.trace import trace_range
+from raft_tpu.distance import DISTANCE_TYPES
+from raft_tpu.ops.matrix import select_k
+
+KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+
+_SERVE_SERIALIZATION_VERSION = 1
+
+_MIN_SIDE_CAP = 8
+
+
+def _kind_module(kind: str):
+    from raft_tpu import neighbors
+
+    if kind not in KINDS:
+        raise ValueError(f"unknown index kind {kind!r}; expected one of {KINDS}")
+    return getattr(neighbors, kind)
+
+
+def _infer_kind(index) -> str:
+    mod = type(index).__module__.rsplit(".", 1)[-1]
+    if mod not in KINDS:
+        raise ValueError(
+            f"cannot infer index kind from {type(index)!r}; pass kind="
+        )
+    return mod
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _bitset_from_np(mask: np.ndarray) -> Bitset:
+    """Pack a host bool mask into a Bitset with numpy-only packing
+    (``Bitset.from_mask`` would run jnp scatter ops for the same job)."""
+    n = mask.shape[0]
+    nw = (n + 31) // 32
+    padded = np.zeros(nw * 32, np.uint8)
+    padded[:n] = mask
+    words = np.packbits(padded, bitorder="little").view(np.uint32)
+    return Bitset(jnp.asarray(words), n)
+
+
+@dataclass(frozen=True)
+class _Snapshot:
+    """Immutable view a search runs against (see thread-safety note)."""
+
+    tombstones: Optional[Bitset]     # over main ids, None when no deletes
+    side_data: Optional[jax.Array]   # [cap, dim] padded, None when empty
+    side_ids: Optional[jax.Array]    # [cap] global ids (-1 on dead slots)
+    side_live: Optional[Bitset]      # pass-filter over side slots
+    generation: int
+
+
+class MutableIndex:
+    """A served index: main (built) structure + tombstones + side buffer.
+
+    Parameters
+    ----------
+    index:
+        A built ``brute_force``/``ivf_flat``/``ivf_pq``/``cagra`` index.
+        Main rows are assumed to carry ids ``0..index.size-1`` (what the
+        builders assign).
+    kind:
+        Backend name; inferred from the index type when omitted.
+    search_params:
+        Per-kind ``SearchParams`` for the main search (ignored for
+        brute_force).  Defaults to the backend's defaults.
+    """
+
+    def __init__(self, index, *, kind: Optional[str] = None, search_params=None):
+        self.kind = kind if kind is not None else _infer_kind(index)
+        mod = _kind_module(self.kind)  # validates kind
+        self.index = index
+        self.metric = index.metric
+        self.dim = int(index.dim)
+        self.main_size = int(index.size)
+        if search_params is None and self.kind != "brute_force":
+            search_params = mod.SearchParams()
+        self.search_params = search_params
+
+        self._lock = threading.Lock()
+        # main-id tombstones, host-side; packed lazily into a Bitset
+        self._deleted = np.zeros((self.main_size,), dtype=bool)
+        self._n_deleted = 0
+        # side buffer, host-side source of truth
+        self._side_data = np.zeros((0, self.dim), dtype=np.float32)
+        self._side_ids = np.zeros((0,), dtype=np.int64)
+        self._side_live = np.zeros((0,), dtype=bool)
+        self._side_count = 0          # occupied slots (live or dead)
+        self._next_id = self.main_size
+        self._generation = 0
+        self._snapshot_cache: Optional[_Snapshot] = None
+        self._refresh_snapshot_locked()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def size(self) -> int:
+        """Live vectors (main minus tombstones, plus live side rows)."""
+        with self._lock:
+            return (
+                self.main_size - self._n_deleted + int(self._side_live.sum())
+            )
+
+    @property
+    def generation(self) -> int:
+        """Monotonic mutation counter (bumps on every upsert/delete)."""
+        with self._lock:
+            return self._generation
+
+    def contains(self, id_: int) -> bool:
+        with self._lock:
+            if 0 <= id_ < self.main_size and not self._deleted[id_]:
+                return True
+            hits = (self._side_ids == id_) & self._side_live
+            return bool(hits.any())
+
+    # -- mutation ------------------------------------------------------------
+    def upsert(self, vectors, ids=None) -> np.ndarray:
+        """Insert (or replace) vectors; returns their global ids.
+
+        Without ``ids`` fresh ids are allocated past the main index's
+        range.  With ``ids``, any existing row under the same id (main or
+        side) is tombstoned first — upsert semantics.
+        """
+        vectors = np.atleast_2d(np.asarray(vectors, dtype=np.float32))
+        if vectors.ndim != 2 or vectors.shape[1] != self.dim:
+            raise ValueError(
+                f"expected vectors of dim {self.dim}, got {vectors.shape}"
+            )
+        m = vectors.shape[0]
+        with self._lock:
+            if ids is None:
+                ids = np.arange(self._next_id, self._next_id + m, dtype=np.int64)
+                self._next_id += m
+            else:
+                ids = np.atleast_1d(np.asarray(ids, dtype=np.int64))
+                if ids.shape != (m,):
+                    raise ValueError(
+                        f"ids shape {ids.shape} does not match {m} vectors"
+                    )
+                self._delete_locked(ids)
+                self._next_id = max(self._next_id, int(ids.max()) + 1)
+            self._reserve_locked(self._side_count + m)
+            sl = slice(self._side_count, self._side_count + m)
+            self._side_data[sl] = vectors
+            self._side_ids[sl] = ids
+            self._side_live[sl] = True
+            self._side_count += m
+            self._bump_locked()
+        return ids
+
+    def delete(self, ids) -> int:
+        """Tombstone ids (main or side); returns how many were live."""
+        ids = np.atleast_1d(np.asarray(ids, dtype=np.int64))
+        with self._lock:
+            n = self._delete_locked(ids)
+            self._bump_locked()
+        return n
+
+    def _delete_locked(self, ids: np.ndarray) -> int:
+        n_removed = 0
+        main = ids[(ids >= 0) & (ids < self.main_size)]
+        if main.size:
+            was_live = ~self._deleted[main]
+            n_removed += int(np.unique(main[was_live]).size)
+            self._deleted[main] = True
+            self._n_deleted = int(self._deleted.sum())
+        if self._side_count:
+            hits = np.isin(self._side_ids, ids) & self._side_live
+            n_removed += int(hits.sum())
+            self._side_live[hits] = False
+        return n_removed
+
+    def _reserve_locked(self, n: int) -> None:
+        cap = self._side_data.shape[0]
+        if n <= cap:
+            return
+        new_cap = max(_MIN_SIDE_CAP, _next_pow2(n))
+        grown = np.zeros((new_cap, self.dim), dtype=np.float32)
+        grown[:cap] = self._side_data
+        self._side_data = grown
+        ids = np.full((new_cap,), -1, dtype=np.int64)
+        ids[:cap] = self._side_ids
+        self._side_ids = ids
+        live = np.zeros((new_cap,), dtype=bool)
+        live[:cap] = self._side_live
+        self._side_live = live
+
+    def _bump_locked(self) -> None:
+        self._generation += 1
+        self._refresh_snapshot_locked()
+
+    def _refresh_snapshot_locked(self) -> None:
+        """Rebuild the search snapshot NOW, at mutation time.
+
+        Mutations are host-side API calls, so this always runs in an eager
+        context — building lazily on first search instead would stage the
+        jnp constants as tracers when that search happens inside a
+        shard_map/jit trace (the replica path) and leak them through the
+        cache."""
+        tomb = _bitset_from_np(self._deleted) if self._n_deleted else None
+        if self._side_count:
+            side_data = jnp.asarray(self._side_data)
+            side_ids = jnp.asarray(
+                np.where(self._side_live, self._side_ids, -1).astype(np.int32)
+            )
+            side_live = _bitset_from_np(self._side_live)
+        else:
+            side_data = side_ids = side_live = None
+        self._snapshot_cache = _Snapshot(
+            tomb, side_data, side_ids, side_live, self._generation
+        )
+
+    # -- search --------------------------------------------------------------
+    def _snapshot(self) -> _Snapshot:
+        with self._lock:
+            return self._snapshot_cache
+
+    def _main_search(self, queries, k, tombstones):
+        mod = _kind_module(self.kind)
+        if self.kind == "brute_force":
+            return mod.search(self.index, queries, k, deleted_mask=tombstones)
+        return mod.search(
+            self.search_params, self.index, queries, k,
+            deleted_mask=tombstones,
+        )
+
+    def search(self, queries, k: int) -> Tuple[jax.Array, jax.Array]:
+        """Merged top-k over main (tombstone-filtered) + side buffer.
+
+        Returns (distances [q, k], ids [q, k]); pruned/padding slots are
+        id −1 at the worst distance, like the backend searches.
+        """
+        queries = jnp.asarray(queries, jnp.float32)
+        if queries.ndim != 2 or queries.shape[1] != self.dim:
+            raise ValueError(
+                f"queries shape {queries.shape} vs index dim {self.dim}"
+            )
+        snap = self._snapshot()
+        with trace_range("serve.mutable_search"):
+            dist, ids = self._main_search(queries, k, snap.tombstones)
+            if snap.side_data is None:
+                return dist, ids
+            from raft_tpu.neighbors import brute_force
+
+            cap = snap.side_data.shape[0]
+            k_side = min(k, cap)
+            s_dist, s_slot = brute_force.knn(
+                snap.side_data, queries, k_side,
+                metric=self.metric, sample_filter=snap.side_live,
+            )
+            # slot → global id (-1 stays -1)
+            s_ids = jnp.where(s_slot >= 0, snap.side_ids[s_slot], -1)
+            select_min = DISTANCE_TYPES[self.metric] != "inner_product"
+            return select_k(
+                jnp.concatenate([dist, s_dist], axis=1),
+                k,
+                select_min=select_min,
+                input_indices=jnp.concatenate(
+                    [ids.astype(jnp.int32), s_ids.astype(jnp.int32)], axis=1
+                ),
+            )
+
+    # -- maintenance ---------------------------------------------------------
+    def pending_mutations(self) -> Tuple[int, int]:
+        """(tombstoned main rows, live side rows) — rebuild pressure."""
+        with self._lock:
+            return self._n_deleted, int(self._side_live.sum())
+
+    def live_vectors(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize (vectors, ids) of every live row — rebuild input.
+
+        Main rows keep their original ids; the caller rebuilding into a
+        fresh index typically renumbers (builders assign 0..n-1).
+        """
+        with self._lock:
+            keep = ~self._deleted
+            main_rows = np.asarray(self._main_dataset())[keep]
+            main_ids = np.nonzero(keep)[0].astype(np.int64)
+            side_rows = self._side_data[self._side_live]
+            side_ids = self._side_ids[self._side_live]
+        return (
+            np.concatenate([main_rows, side_rows], axis=0),
+            np.concatenate([main_ids, side_ids], axis=0),
+        )
+
+    def _main_dataset(self) -> np.ndarray:
+        """Recover the main rows in id order (for rebuild/consistency)."""
+        if self.kind in ("brute_force", "cagra"):
+            return np.asarray(self.index.dataset)
+        # IVF variants: scatter padded lists back by source id
+        out = np.zeros((self.main_size, self.dim), dtype=np.float32)
+        data = np.asarray(self.index.list_data, dtype=np.float32)
+        idx = np.asarray(self.index.list_index)
+        valid = idx >= 0
+        if self.kind == "ivf_pq":
+            # decoded reconstructions live in rotated space (possibly int8
+            # scan cache, hence scan_scale); invert the orthonormal rotation
+            rot = np.asarray(self.index.rotation, dtype=np.float32)
+            out[idx[valid]] = (data[valid] * float(self.index.scan_scale)) @ rot
+        else:
+            out[idx[valid]] = data[valid]
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Snapshot serve state to ``path`` + main index to ``path.main``."""
+        mod = _kind_module(self.kind)
+        with self._lock:
+            scalars = {
+                "kind": self.kind,
+                "main_size": self.main_size,
+                "side_count": self._side_count,
+                "next_id": self._next_id,
+                "generation": self._generation,
+                "dim": self.dim,
+            }
+            arrays = {
+                "deleted": self._deleted,
+                "side_data": self._side_data,
+                "side_ids": self._side_ids,
+                "side_live": self._side_live,
+            }
+            ser.save_tree(
+                path, "serve_mutable", _SERVE_SERIALIZATION_VERSION,
+                scalars, arrays,
+            )
+        if self.kind == "cagra":
+            mod.save(path + ".main", self.index, include_dataset=True)
+        else:
+            mod.save(path + ".main", self.index)
+
+    @classmethod
+    def load(cls, path: str, *, search_params=None) -> "MutableIndex":
+        scalars, arrays = ser.load_tree(
+            path, "serve_mutable", _SERVE_SERIALIZATION_VERSION
+        )
+        mod = _kind_module(scalars["kind"])
+        index = mod.load(path + ".main")
+        out = cls(index, kind=scalars["kind"], search_params=search_params)
+        with out._lock:
+            out._deleted = np.asarray(arrays["deleted"], dtype=bool)
+            out._n_deleted = int(out._deleted.sum())
+            out._side_data = np.asarray(arrays["side_data"], dtype=np.float32)
+            out._side_ids = np.asarray(arrays["side_ids"], dtype=np.int64)
+            out._side_live = np.asarray(arrays["side_live"], dtype=bool)
+            out._side_count = int(scalars["side_count"])
+            out._next_id = int(scalars["next_id"])
+            out._generation = int(scalars["generation"])
+            out._refresh_snapshot_locked()
+        return out

@@ -1,0 +1,129 @@
+"""Index registry: named, versioned indexes with atomic hot-swap.
+
+The registry is the level of indirection that lets an offline rebuild
+replace a live index without pausing traffic: queries resolve the name to
+a concrete :class:`~raft_tpu.serve.mutation.MutableIndex` *once per
+dispatched batch* (see ``SearchService``), so a swap is atomic at batch
+granularity — every result row in a batch comes from exactly one index
+version, and in-flight batches keep the old version alive by reference
+until they finish.  Swapping same-shaped indexes also costs zero
+recompiles, since the compiled executables key on shapes, not weights.
+
+Snapshots write one file per index (via ``MutableIndex.save``) plus a
+manifest binding names to versions, through ``core.serialize`` — restore
+round-trips tombstones and side buffers, not just the built structure.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.serve.mutation import MutableIndex
+
+_MANIFEST_VERSION = 1
+_MANIFEST_NAME = "MANIFEST"
+
+
+class IndexRegistry:
+    """Thread-safe name → (index, version) map with atomic replacement."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Tuple[MutableIndex, int]] = {}
+
+    # -- registration / swap -------------------------------------------------
+    def register(
+        self, name: str, index: MutableIndex, *, version: Optional[int] = None
+    ) -> int:
+        """Bind ``name`` to ``index`` atomically; returns the new version.
+
+        Re-registering an existing name IS the hot-swap: the version
+        auto-increments (unless given) and readers see either the old or
+        the new index, never a mix.
+        """
+        if not isinstance(index, MutableIndex):
+            raise TypeError(
+                f"registry holds MutableIndex, got {type(index)!r}; wrap "
+                "built indexes with MutableIndex(index)"
+            )
+        with self._lock:
+            if version is None:
+                prev = self._entries.get(name)
+                version = prev[1] + 1 if prev is not None else 1
+            # tuple replacement is a single reference store — atomic for
+            # readers holding no lock
+            self._entries[name] = (index, version)
+            return version
+
+    def swap(self, name: str, index: MutableIndex) -> int:
+        """Hot-swap an existing name; raises KeyError if unknown."""
+        with self._lock:
+            if name not in self._entries:
+                raise KeyError(f"no index named {name!r} to swap")
+            version = self._entries[name][1] + 1
+            self._entries[name] = (index, version)
+            return version
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            del self._entries[name]
+
+    # -- resolution ----------------------------------------------------------
+    def get(self, name: str) -> MutableIndex:
+        with self._lock:
+            return self._entries[name][0]
+
+    def get_versioned(self, name: str) -> Tuple[MutableIndex, int]:
+        """(index, version) resolved atomically — batch-dispatch entry."""
+        with self._lock:
+            return self._entries[name]
+
+    def version(self, name: str) -> int:
+        with self._lock:
+            return self._entries[name][1]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- persistence ---------------------------------------------------------
+    def snapshot(self, directory: str) -> None:
+        """Write every index + a name→version manifest under ``directory``."""
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            entries = dict(self._entries)
+        scalars = {"count": len(entries)}
+        for i, name in enumerate(sorted(entries)):
+            index, version = entries[name]
+            scalars[f"name_{i}"] = name
+            scalars[f"version_{i}"] = version
+            index.save(os.path.join(directory, f"{name}.idx"))
+        ser.save_tree(
+            os.path.join(directory, _MANIFEST_NAME),
+            "serve_registry", _MANIFEST_VERSION, scalars, {},
+        )
+
+    @classmethod
+    def restore(cls, directory: str) -> "IndexRegistry":
+        scalars, _ = ser.load_tree(
+            os.path.join(directory, _MANIFEST_NAME),
+            "serve_registry", _MANIFEST_VERSION,
+        )
+        reg = cls()
+        for i in range(int(scalars["count"])):
+            name = scalars[f"name_{i}"]
+            version = int(scalars[f"version_{i}"])
+            index = MutableIndex.load(os.path.join(directory, f"{name}.idx"))
+            reg.register(name, index, version=version)
+        return reg

@@ -1,0 +1,159 @@
+"""Multi-chip serving: replicated index, query-sharded dispatch.
+
+Query serving scales differently from index building: the index fits on
+one chip (or is already sharded by comms/), and the scarce resource is
+*query throughput*.  The serving answer is data parallelism over the
+query stream — the index is replicated across the mesh axis, a batch of
+queries shards ``P(axis, None)``, every device runs the full search on
+its slice, and the per-shard results all-gather back replicated (the
+same shape the single-device search returns, so the batcher cannot tell
+the difference).  N devices ≈ N× the batch throughput at identical
+per-query results.
+
+This composes with the rest of the serve stack: ``ReplicaGroup`` wraps an
+:class:`~raft_tpu.serve.registry.IndexRegistry`, so hot-swap and
+mutations behave exactly as in the single-chip path (the snapshot a
+search closes over is replicated at trace time).
+
+Shape discipline: query shards are ``bucket/size`` rows, so warming the
+bucket ladder warms the replicated executables too — one compile per
+bucket, independent of device count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, local_comms
+from raft_tpu.core.compat import shard_map
+from raft_tpu.core.trace import trace_range
+from raft_tpu.serve.registry import IndexRegistry
+
+
+def make_replicated_search(comms: Comms, search_fn):
+    """Build a reusable ``(queries, k) -> (distances, ids)`` replicated
+    searcher around ``search_fn(queries_shard, k)``.
+
+    ``search_fn`` must be traceable given a [q_shard, dim] query array
+    (all index state enters as closure constants — every backend search
+    and ``MutableIndex.search`` qualify).  Queries are padded to a
+    multiple of the axis size; padded rows are dropped from the result.
+
+    The returned callable owns its executables: the shard_map body is
+    wrapped in a persistent ``jax.jit`` per k, so repeated calls at the
+    same (k, padded batch) shape reuse one compile — the zero-recompile
+    contract the batcher's warmup ladder relies on.  Build it ONCE per
+    index state (the serve path keys it on registry version + mutation
+    generation) and call it many times.
+    """
+    mesh, axis = comms.mesh, comms.axis
+    size = comms.get_size()
+    # the per-shard search runs under jit, not bare in the shard_map body:
+    # older jax's ShardMapTracer lacks the eager operator surface (bitwise
+    # ops on closure constants fail), while nested-jit tracers are complete
+    jitted = jax.jit(search_fn, static_argnums=1)
+    sharded = {}  # k -> jitted shard_map wrapper
+
+    def _sharded(k: int):
+        f = sharded.get(k)
+        if f is None:
+
+            def local(q_shard):
+                v, i = jitted(q_shard, k)
+                vg = lax.all_gather(v, axis, axis=0, tiled=True)
+                ig = lax.all_gather(i, axis, axis=0, tiled=True)
+                return vg, ig
+
+            f = jax.jit(
+                shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(P(axis, None),),
+                    out_specs=(P(None, None), P(None, None)),
+                    check_vma=False,
+                )
+            )
+            sharded[k] = f
+        return f
+
+    def run(queries, k: int) -> Tuple[jax.Array, jax.Array]:
+        queries = jnp.asarray(queries, jnp.float32)
+        q = queries.shape[0]
+        q_pad = -(-q // size) * size
+        if q_pad != q:
+            queries = jnp.pad(queries, ((0, q_pad - q), (0, 0)))
+        qs = jax.device_put(queries, NamedSharding(mesh, P(axis, None)))
+        with trace_range("serve.replicated_search"):
+            v, i = _sharded(k)(qs)
+        return v[:q], i[:q]
+
+    return run
+
+
+def replicated_search(
+    comms: Comms,
+    search_fn,
+    queries: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot convenience over :func:`make_replicated_search`.
+
+    Compiles fresh every call — for repeated serving use
+    ``make_replicated_search`` (or :class:`ReplicaGroup`, which caches).
+    Returns replicated (distances [q, k], ids [q, k]).
+    """
+    return make_replicated_search(comms, search_fn)(queries, k)
+
+
+class ReplicaGroup:
+    """A registry served data-parallel across the local mesh.
+
+    Resolves names through the registry *per call* (so hot-swaps apply to
+    the next batch) and runs the resolved index's merged mutable search
+    replicated over the comms axis.  Drop-in as a batcher ``search_fn``
+    via :meth:`searcher`.
+    """
+
+    def __init__(
+        self,
+        registry: IndexRegistry,
+        comms: Optional[Comms] = None,
+        *,
+        n_devices: Optional[int] = None,
+    ):
+        self.registry = registry
+        self.comms = comms if comms is not None else local_comms(n_devices)
+        # per-name replicated searcher, keyed on (version, generation) so
+        # hot-swaps and mutations retrace while steady-state traffic reuses
+        # the warmed executables (zero hot-path recompiles)
+        self._searchers = {}
+
+    @property
+    def n_replicas(self) -> int:
+        return self.comms.get_size()
+
+    def search(
+        self, name: str, queries, k: int
+    ) -> Tuple[jax.Array, jax.Array]:
+        index, version = self.registry.get_versioned(name)
+        key = (version, getattr(index, "generation", 0))
+        cached = self._searchers.get(name)
+        if cached is None or cached[0] != key:
+            run = make_replicated_search(
+                self.comms, lambda q_shard, kk: index.search(q_shard, kk)
+            )
+            self._searchers[name] = cached = (key, run)
+        return cached[1](queries, k)
+
+    def searcher(self, name: str, k: int):
+        """A ``queries -> (distances, ids)`` callable for MicroBatcher."""
+
+        def search_fn(queries):
+            return self.search(name, queries, k)
+
+        return search_fn

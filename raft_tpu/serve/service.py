@@ -1,0 +1,192 @@
+"""SearchService: the assembled online query-serving front end.
+
+One object wires the serve stack together: an
+:class:`~raft_tpu.serve.registry.IndexRegistry` of named
+:class:`~raft_tpu.serve.mutation.MutableIndex` es, one
+:class:`~raft_tpu.serve.batcher.MicroBatcher` per served name (each with
+its own bucket ladder + :class:`~raft_tpu.serve.metrics.ServingMetrics`),
+and optionally a :class:`~raft_tpu.serve.replica.ReplicaGroup` for
+query-sharded multi-chip dispatch.
+
+The atomicity contract lives here: a batcher's ``search_fn`` resolves the
+registry **once per dispatched batch**, so every row of a coalesced batch
+is answered by exactly one index version — :meth:`swap` never tears a
+batch, and in-flight batches pin the old version by reference until they
+complete.  Swapping a same-shaped index costs zero recompiles (compiled
+executables key on shapes, not weights); ``tests/test_serve.py`` pins
+both properties.
+
+Typical lifecycle::
+
+    svc = SearchService(k=10)
+    svc.add_index("wiki", MutableIndex(built), warmup=True)
+    dists, ids = svc.search("wiki", query_vec)     # sync
+    fut = svc.submit("wiki", query_vec)            # async, coalesced
+    svc.get("wiki").upsert(new_rows)               # visible immediately
+    svc.swap("wiki", MutableIndex(rebuilt))        # atomic hot-swap
+    svc.stats("wiki")                              # qps/p50/p99/recompiles
+    svc.stop()
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from raft_tpu.serve.batcher import MicroBatcher
+from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
+from raft_tpu.serve.mutation import MutableIndex
+from raft_tpu.serve.registry import IndexRegistry
+from raft_tpu.serve.replica import ReplicaGroup
+
+
+class SearchService:
+    """Serve named mutable indexes through per-index micro-batchers."""
+
+    def __init__(
+        self,
+        registry: Optional[IndexRegistry] = None,
+        *,
+        k: int = 10,
+        min_bucket: int = 1,
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+        replicas: Optional[ReplicaGroup] = None,
+        start: bool = True,
+    ):
+        install_compile_listener()
+        self.registry = registry if registry is not None else IndexRegistry()
+        self.k = int(k)
+        self.min_bucket = min_bucket
+        self.max_batch = max_batch
+        self.max_delay_ms = max_delay_ms
+        self.replicas = replicas
+        self._start = start
+        self._lock = threading.Lock()
+        self._batchers: Dict[str, MicroBatcher] = {}
+
+    # -- index management ----------------------------------------------------
+    def add_index(
+        self, name: str, index, *, warmup: bool = False, k: Optional[int] = None
+    ) -> int:
+        """Register ``index`` under ``name`` and start its batcher.
+
+        ``index`` may be a raw built index (wrapped automatically) or a
+        :class:`MutableIndex`.  With ``warmup`` the whole bucket ladder is
+        compiled before the method returns, so the first real query is
+        already on the hot path.
+        """
+        if not isinstance(index, MutableIndex):
+            index = MutableIndex(index)
+        version = self.registry.register(name, index)
+        k = self.k if k is None else int(k)
+        with self._lock:
+            old = self._batchers.pop(name, None)
+            batcher = MicroBatcher(
+                self._make_search_fn(name, k),
+                index.dim,
+                min_bucket=self.min_bucket,
+                max_batch=self.max_batch,
+                max_delay_ms=self.max_delay_ms,
+                metrics=ServingMetrics(),
+                start=self._start,
+            )
+            self._batchers[name] = batcher
+        if old is not None:
+            old.stop()
+        if warmup:
+            batcher.warmup()
+        return version
+
+    def _make_search_fn(self, name: str, k: int):
+        def search_fn(queries):
+            # resolve once per BATCH: the whole padded batch is answered
+            # by one index version (hot-swap atomicity boundary)
+            index, _version = self.registry.get_versioned(name)
+            if self.replicas is not None:
+                return self.replicas.search(name, queries, k)
+            return index.search(queries, k)
+
+        return search_fn
+
+    def swap(self, name: str, index) -> int:
+        """Atomically replace the index behind ``name`` (see module doc).
+
+        The existing batcher (and its warmed executables) is kept: a
+        same-shaped replacement serves its next batch with no recompile.
+        """
+        if not isinstance(index, MutableIndex):
+            index = MutableIndex(index)
+        with self._lock:
+            if name not in self._batchers:
+                raise KeyError(f"no served index named {name!r}")
+            if index.dim != self._batchers[name].dim:
+                raise ValueError(
+                    f"swap dim mismatch for {name!r}: "
+                    f"{index.dim} != {self._batchers[name].dim}"
+                )
+        return self.registry.swap(name, index)
+
+    def get(self, name: str) -> MutableIndex:
+        """The live index (for upsert/delete — visible to the next batch)."""
+        return self.registry.get(name)
+
+    def remove_index(self, name: str) -> None:
+        with self._lock:
+            batcher = self._batchers.pop(name)
+        batcher.stop()
+        self.registry.unregister(name)
+
+    def names(self):
+        return self.registry.names()
+
+    # -- querying ------------------------------------------------------------
+    def _batcher(self, name: str) -> MicroBatcher:
+        with self._lock:
+            return self._batchers[name]
+
+    def submit(self, name: str, queries):
+        """Async search; returns a Future of (distances, ids)."""
+        return self._batcher(name).submit(queries)
+
+    def search(self, name: str, queries, timeout: Optional[float] = None):
+        """Sync search through the batcher (coalesces with live traffic)."""
+        return self._batcher(name).search(queries, timeout=timeout)
+
+    def warmup(self, name: Optional[str] = None) -> int:
+        """Compile the bucket ladder(s); returns total compiles spent."""
+        names = [name] if name is not None else self.names()
+        return sum(self._batcher(n).warmup() for n in names)
+
+    def flush(self, name: Optional[str] = None) -> int:
+        names = [name] if name is not None else self.names()
+        return sum(self._batcher(n).flush() for n in names)
+
+    # -- observability -------------------------------------------------------
+    def stats(self, name: str) -> Dict[str, object]:
+        """Metrics snapshot + index version/size for one served name."""
+        index, version = self.registry.get_versioned(name)
+        out = self._batcher(name).metrics.snapshot()
+        deleted, side = index.pending_mutations()
+        out.update(
+            name=name,
+            version=version,
+            kind=index.kind,
+            size=index.size,
+            pending_deletes=deleted,
+            side_rows=side,
+        )
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self) -> None:
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.stop()
+
+    def __enter__(self) -> "SearchService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

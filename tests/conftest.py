@@ -19,7 +19,9 @@ import jax
 # default is the 8-virtual-device CPU mesh described above.
 if not os.environ.get("RAFT_TPU_TEST_DEVICE"):
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from raft_tpu.core.compat import set_host_device_count
+
+    set_host_device_count(8)
 jax.config.update("jax_enable_x64", False)
 
 import numpy as np  # noqa: E402
@@ -50,10 +52,6 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
-
-
-def pytest_configure(config):
-    config.addinivalue_line("markers", "slow: large-scale (10^5+ rows) tests")
 
 
 @pytest.fixture

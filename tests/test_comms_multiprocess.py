@@ -26,7 +26,8 @@ proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from raft_tpu.core.compat import set_host_device_count
+set_host_device_count(2)
 
 from raft_tpu import comms as rc
 
@@ -77,7 +78,8 @@ _ENV_WORKER_SRC = r"""
 import os
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from raft_tpu.core.compat import set_host_device_count
+set_host_device_count(2)
 
 from raft_tpu import comms as rc
 
@@ -187,7 +189,8 @@ proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from raft_tpu.core.compat import set_host_device_count
+set_host_device_count(2)
 
 import numpy as np
 from raft_tpu import comms as rc
@@ -253,6 +256,7 @@ print(f"WORKER_OK {proc_id} ivf_pq_recall={r:.3f} cagra_recall={cr:.3f}",
 """
 
 
+@pytest.mark.slow  # n>=1e5 2-process build+search: ~5 min on the CI core
 @pytest.mark.parametrize("nprocs", [2])
 def test_multiprocess_sharded_ann_scale(nprocs, tmp_path):
     """2-process sharded IVF-PQ at n>=1e5 with a recall gate + the

@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from raft_tpu.comms import Comms, make_mesh
 from raft_tpu.comms.distributed import shard_ivf_pq_index, sharded_ivf_pq_search
@@ -325,6 +326,7 @@ def test_sharded_ivf_flat_matches_single_device():
     assert ovc >= 0.98, ovc
 
 
+@pytest.mark.slow  # three full GNND builds back-to-back (~1 min)
 def test_sharded_cagra_build_split_invariant():
     """sharded_cagra_build must produce a bit-identical index for any
     device count (per-batch keys fold in the GLOBAL batch id; fixed

@@ -38,9 +38,9 @@ def test_select_k_large_ints_exact():
 
 def test_comms_prod_with_negatives():
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
     from raft_tpu.comms import local_comms
+    from raft_tpu.core.compat import shard_map
 
     comms = local_comms(8)
 
