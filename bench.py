@@ -146,6 +146,9 @@ def main() -> None:
     if "serve" in sys.argv[1:]:
         run_serve_leg()
         return
+    if "obs" in sys.argv[1:]:
+        run_obs_leg()
+        return
     if probe_tpu() is not None:
         # verify cache serialization in a subprocess first — an unverified/
         # broken cache must never hang the bench
@@ -463,6 +466,98 @@ def run_serve_leg() -> None:
                 "warmup_compiles": st["warmup_compiles"],
                 "requests": n_requests,
                 "n": n,
+            }
+        )
+    )
+
+
+def run_obs_leg() -> None:
+    """``python bench.py obs`` — the serve leg with the observability
+    registry emitted alongside the QPS numbers (CPU).
+
+    Same workload shape as ``serve`` but smaller, because the payload here
+    is the *metrics*, not the throughput: the JSON line carries the
+    process registry snapshot — span latency histograms for every traced
+    entry point the workload crossed, XLA compiles attributed to the span
+    that caused them, executable-cache hits, the queue/pad/dispatch/device
+    stage breakdown, and the slow-query log.  One line answers "where did
+    the milliseconds go" for a whole serving session.
+    """
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu import obs, serve
+    from raft_tpu.neighbors import ivf_flat
+
+    obs.install()
+    n, d, k = 4096, 64, 10
+    n_requests, n_clients = 256, 4
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_requests, d), dtype=np.float32)
+
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=32), dataset)
+    svc = serve.SearchService(k=k, max_batch=32, max_delay_ms=0.5)
+    svc.add_index(
+        "bench", serve.MutableIndex(
+            index, search_params=ivf_flat.SearchParams(n_probes=8)
+        ),
+        warmup=True,
+    )
+
+    def client(cid: int):
+        futs = [
+            svc.submit("bench", queries[i])
+            for i in range(cid, n_requests, n_clients)
+        ]
+        for f in futs:
+            f.result(timeout=120)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(c,)) for c in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    st = svc.stats("bench")
+    snap = svc.metrics()["registry"]
+    svc.stop()
+    compiles_by_span = snap["counters"].get("raft_tpu_xla_compiles_total", {})
+    print(
+        json.dumps(
+            {
+                "metric": f"obs_serve_qps_ivf_flat_n{n // 1000}k_k{k}",
+                "value": round(n_requests / wall, 1),
+                "unit": "queries/s",
+                "platform": "cpu",
+                "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+                "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+                "recompiles": st["recompiles"],
+                "stages_ms": {
+                    s: {q: round(v, 3) for q, v in p.items()}
+                    for s, p in st["stages"].items()
+                },
+                "xla_compiles_by_span": compiles_by_span,
+                "xla_cache": snap["counters"].get(
+                    "raft_tpu_xla_executable_cache_total", {}
+                ),
+                "span_histograms": sorted(
+                    key.split("=", 1)[1]
+                    for key in snap["histograms"].get(
+                        "raft_tpu_span_seconds", {}
+                    )
+                ),
+                "slow_queries": len(snap["slow_queries"]["recent"]),
+                "requests": n_requests,
             }
         )
     )

@@ -28,6 +28,9 @@ Architecture (bottom → top), mirroring the reference's layer map
                            (ref: cpp/include/raft/stats/)
 - ``raft_tpu.comms``     — comms facade over XLA collectives (psum/all_gather/...)
                            (ref: cpp/include/raft/comms/, core/comms.hpp)
+- ``raft_tpu.obs``       — observability: metrics registry, spans, XLA event
+                           attribution, Prometheus/JSON export
+                           (ref: core/nvtx.hpp + core/logger-inl.hpp, made queryable)
 - ``raft_tpu.bench``     — ANN benchmark harness (ref: cpp/bench/ann/, raft-ann-bench)
 
 Everything is functional and jit-friendly: static shapes, `lax` control flow,

@@ -15,8 +15,10 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans
 from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.core.trace import traced
 
 
+@traced("cluster.find_k")
 def find_k(
     x: jax.Array,
     kmax: int,

@@ -61,6 +61,7 @@ def _assign(
     return best.reshape(-1)[:n], labels.reshape(-1)[:n]
 
 
+@traced("kmeans.plus_plus_init")
 def kmeans_plus_plus_init(
     key: jax.Array, x: jax.Array, n_clusters: int, weights: Optional[jax.Array] = None
 ) -> jax.Array:
@@ -91,6 +92,7 @@ def kmeans_plus_plus_init(
     return centers
 
 
+@traced("kmeans.compute_new_centroids")
 def compute_new_centroids(
     x: jax.Array,
     centroids: jax.Array,
@@ -243,6 +245,7 @@ def fit_predict(
     return centroids, labels, inertia, n_iter
 
 
+@traced("kmeans.transform")
 def transform(centroids: jax.Array, x: jax.Array) -> jax.Array:
     """Distances to every centroid (ref: kmeans.cuh kmeans_transform)."""
     return distance_matrix_tile(
@@ -250,6 +253,7 @@ def transform(centroids: jax.Array, x: jax.Array) -> jax.Array:
     )
 
 
+@traced("kmeans.cluster_cost")
 def cluster_cost(
     x: jax.Array,
     centroids: jax.Array,

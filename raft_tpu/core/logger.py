@@ -15,6 +15,15 @@ import logging
 
 logger = logging.getLogger("raft_tpu")
 
+
+def child(name: str) -> logging.Logger:
+    """Namespaced sub-logger (``raft_tpu.<name>``) — one configuration
+    point (handlers/levels on ``raft_tpu``) fans out to every subsystem,
+    the spdlog-singleton idiom of the reference.  Used by e.g. the
+    slow-query log (``raft_tpu.obs.slowlog``) so its WARNING lines can be
+    routed or silenced independently of algorithm debug output."""
+    return logger.getChild(name)
+
 # native levels (cpp/include/raft_tpu/core/logger.hpp) → logging levels
 _NATIVE_TO_PY = {
     0: logging.CRITICAL,  # off → nothing should arrive, map high

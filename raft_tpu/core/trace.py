@@ -8,9 +8,14 @@ at e.g. neighbors/detail/ivf_pq_build.cuh:1687).  The TPU equivalents are
   up in ``jax.profiler.trace`` captures (the "domain" maps to the
   ``raft_tpu.`` prefix);
 - ``jax.named_scope`` — attaches the name to the HLO ops traced under the
-  range so device-side work is attributable in the profile.
+  range so device-side work is attributable in the profile;
+- a :mod:`raft_tpu.obs` span — the queryable record: every range reports
+  wall time into the metrics registry and becomes the attribution point
+  for XLA compile/cache/transfer events, with no profiler attached.
 
-Both are near-zero-cost when no profiler session is active.
+All three are near-zero-cost when nothing is listening; the obs span adds
+one histogram record per call (bounded by ``tests/test_obs.py``'s
+overhead guard).
 """
 
 from __future__ import annotations
@@ -26,17 +31,44 @@ DOMAIN = "raft_tpu"
 
 F = TypeVar("F", bound=Callable)
 
+_spans = None  # lazy: raft_tpu.obs pulls numpy/logger machinery not needed
+               # by pure-trace consumers until the first range actually opens
+
+
+def _obs_spans():
+    global _spans
+    if _spans is None:
+        from raft_tpu.obs import spans
+
+        _spans = spans
+    return _spans
+
 
 @contextlib.contextmanager
 def trace_range(name: str):
-    """Scoped profiler range ``raft_tpu.<name>`` (ref: nvtx.hpp range)."""
+    """Scoped profiler range ``raft_tpu.<name>`` (ref: nvtx.hpp range).
+
+    Yields the open :class:`raft_tpu.obs.Span` (or ``None`` when obs is
+    disabled) so call sites can attach stage timings::
+
+        with trace_range("serve.batch") as sp:
+            ...
+            if sp is not None:
+                sp.add_stage("dispatch", dt)
+    """
     full = f"{DOMAIN}.{name}"
     with jax.profiler.TraceAnnotation(full), jax.named_scope(name):
-        yield
+        with _obs_spans().span(name) as sp:
+            yield sp
 
 
 def traced(name: Optional[str] = None) -> Callable[[F], F]:
-    """Decorator form of :func:`trace_range` for public API entries."""
+    """Decorator form of :func:`trace_range` for public API entries.
+
+    The wrapper carries ``__traced__`` (the range label) so static checks
+    — ``tests/test_trace_coverage.py`` — can verify every public entry
+    point ships observable.
+    """
 
     def deco(fn: F) -> F:
         label = name or fn.__qualname__
@@ -46,6 +78,7 @@ def traced(name: Optional[str] = None) -> Callable[[F], F]:
             with trace_range(label):
                 return fn(*args, **kwargs)
 
+        wrapper.__traced__ = label  # type: ignore[attr-defined]
         return wrapper  # type: ignore[return-value]
 
     return deco
@@ -57,7 +90,8 @@ def profile(log_dir: str, *, host_tracer_level: int = 2):
 
     Thin wrapper over ``jax.profiler.trace`` so benches/tests don't import
     jax.profiler directly (mirrors the reference gating NVTX behind a CMake
-    flag — here a no-op if RAFT_TPU_DISABLE_PROFILER is set).
+    flag — here a no-op if RAFT_TPU_DISABLE_PROFILER is set).  The
+    span-integrated variant lives at :func:`raft_tpu.obs.profile`.
     """
     if os.environ.get("RAFT_TPU_DISABLE_PROFILER"):
         yield

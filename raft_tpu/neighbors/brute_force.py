@@ -366,6 +366,7 @@ def make_batch_k_query(
     return BatchKQuery(index, queries, batch_size, res=res)
 
 
+@traced("brute_force.save")
 def save(filename: str, index: Index) -> None:
     """(ref: brute_force serialize — version-stamped, SURVEY §5 checkpoint)"""
     ser.save_tree(
@@ -377,6 +378,7 @@ def save(filename: str, index: Index) -> None:
     )
 
 
+@traced("brute_force.load")
 def load(filename: str) -> Index:
     scalars, arrays = ser.load_tree(filename, "brute_force", _SERIALIZATION_VERSION)
     return Index(jnp.asarray(arrays["dataset"]), scalars["metric"])

@@ -749,6 +749,7 @@ def search(
 # serialization (ref: detail/cagra/cagra_serialize.cuh)
 # --------------------------------------------------------------------------
 
+@traced("cagra.save")
 def save(filename: str, index: Index, *, include_dataset: bool = True) -> None:
     from raft_tpu.neighbors.vpq_dataset import VpqDataset
 
@@ -782,6 +783,7 @@ def save(filename: str, index: Index, *, include_dataset: bool = True) -> None:
     )
 
 
+@traced("cagra.load")
 def load(filename: str, *, dataset: Optional[jax.Array] = None) -> Index:
     from raft_tpu.neighbors.vpq_dataset import VpqDataset
 

@@ -16,11 +16,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.core.trace import traced
 from raft_tpu.distance.pairwise import _PREC, pairwise_distance
 from raft_tpu.neighbors import brute_force
 from raft_tpu.ops.matrix import select_k
 
 
+@traced("extras.epsilon_neighborhood")
 def epsilon_neighborhood(
     x: jax.Array,
     y: jax.Array,
@@ -46,6 +48,7 @@ def epsilon_neighborhood(
 
 
 @functools.partial(jax.jit, static_argnames=("sqrt",))
+@traced("extras.masked_l2_nn")
 def masked_l2_nn(
     x: jax.Array,
     y: jax.Array,

@@ -111,6 +111,7 @@ def _as_deleted_bools(deleted, n: int) -> Optional[np.ndarray]:
     return out
 
 
+@traced("hnsw.serialize_to_hnswlib")
 def serialize_to_hnswlib(
     filename: str, index: "cagra.Index", *, hierarchy: bool = True,
     seed: int = 0, deleted=None,
@@ -201,6 +202,7 @@ def serialize_to_hnswlib(
                 fh.write(padded.tobytes())
 
 
+@traced("hnsw.load")
 def load(
     filename: str, dim: int, *, metric: str = "sqeuclidean",
     return_deleted: bool = False,
@@ -259,6 +261,7 @@ def load(
     return index
 
 
+@traced("hnsw.search")
 def search(
     index: "cagra.Index",
     queries: jax.Array,

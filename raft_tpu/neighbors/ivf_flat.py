@@ -707,6 +707,7 @@ def search(
     )
 
 
+@traced("ivf_flat.save")
 def save(filename: str, index: Index) -> None:
     ser.save_tree(
         filename,
@@ -725,6 +726,7 @@ def save(filename: str, index: Index) -> None:
     )
 
 
+@traced("ivf_flat.load")
 def load(filename: str) -> Index:
     scalars, arrays = ser.load_tree(filename, "ivf_flat", _SERIALIZATION_VERSION)
     return Index(

@@ -1443,6 +1443,7 @@ def _unpack_bits(packed: np.ndarray, pq_dim: int, pq_bits: int) -> np.ndarray:
     return np.packbits(full, axis=-1, bitorder="little")[..., 0]
 
 
+@traced("ivf_pq.save")
 def save(filename: str, index: Index) -> None:
     lc = np.asarray(index.list_codes)
     L, cap, pq_dim = lc.shape
@@ -1476,6 +1477,7 @@ def save(filename: str, index: Index) -> None:
     )
 
 
+@traced("ivf_pq.load")
 def load(filename: str) -> Index:
     scalars, arrays = ser.load_tree(filename, "ivf_pq", _SERIALIZATION_VERSION)
     L = arrays["centers"].shape[0]

@@ -25,6 +25,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.resources import Resources, ensure
+from raft_tpu.core.trace import traced
 from raft_tpu.distance.pairwise import _PREC
 from raft_tpu.neighbors.ivf_pq import _train_codebooks_lloyd
 
@@ -89,6 +90,7 @@ def _auto_vq_centers(n: int) -> int:
     return int(np.clip(int(np.sqrt(n)), 16, 1 << 16))
 
 
+@traced("vpq_dataset.build")
 def build(
     params: VpqParams,
     dataset: jax.Array,

@@ -1,0 +1,99 @@
+"""raft_tpu.obs — unified observability: metrics, spans, XLA events.
+
+The reference RAFT instruments every entry point with NVTX ranges and
+spdlog (core/nvtx.hpp, core/logger-inl.hpp) and reads the story back
+through Nsight.  A TPU serving deployment needs that story *without a
+profiler attached*, so this package turns the existing instrumentation
+into queryable state:
+
+- :mod:`~raft_tpu.obs.registry` — process-wide thread-safe metrics
+  (counters, gauges, labeled histograms with fixed bucket ladders and a
+  label-cardinality cap that raises instead of leaking).
+- :mod:`~raft_tpu.obs.spans` — structured spans (id, parent, wall time,
+  stage timings) fed automatically by ``core.trace.trace_range`` /
+  ``@traced``, i.e. every already-instrumented entry point in
+  ``neighbors/``, ``cluster/`` and ``serve/`` reports with zero call-site
+  churn.
+- :mod:`~raft_tpu.obs.xla_events` — ``jax.monitoring`` listeners for
+  compile durations, executable-cache hits and transfer events,
+  attributed to the enclosing span.
+- :mod:`~raft_tpu.obs.export` — Prometheus text format + JSON snapshot.
+- :mod:`~raft_tpu.obs.slowlog` — slow-query log with stage breakdowns.
+- :mod:`~raft_tpu.obs.profiler` — ``obs.profile(dir)``: one-line
+  Perfetto capture.
+
+Quick start::
+
+    from raft_tpu import obs
+    obs.install()                      # XLA listeners + span/slowlog merge
+    ... build / search / serve ...
+    print(obs.snapshot())              # JSON-safe dict
+    print(obs.to_prometheus())         # scrape document
+    with obs.profile("/tmp/trace"):    # deep dive
+        index = ivf_pq.build(params, dataset)
+
+See ``docs/observability.md`` for the guided tour.
+"""
+
+from raft_tpu.obs.export import snapshot_json, to_prometheus, write_snapshot
+from raft_tpu.obs.profiler import profile
+from raft_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    LabelCardinalityError,
+    MetricsRegistry,
+    default_registry,
+)
+from raft_tpu.obs.slowlog import slowlog_snapshot
+from raft_tpu.obs.spans import (
+    Span,
+    current_span,
+    recent_spans,
+    set_enabled,
+    span,
+    spans_snapshot,
+)
+from raft_tpu.obs import slowlog, spans, xla_events
+
+registry = default_registry  # `obs.registry()` reads as the obvious accessor
+
+
+def install() -> None:
+    """Activate the full pipeline: XLA monitoring listeners plus the span
+    and slow-query sections in registry snapshots.  Idempotent."""
+    xla_events.install()
+    reg = default_registry()
+    reg.register_provider("spans", spans_snapshot)
+    reg.register_provider("slow_queries", slowlog_snapshot)
+
+
+def snapshot():
+    """JSON-safe snapshot of the process registry (counters, gauges,
+    histograms, plus every registered provider section)."""
+    return default_registry().snapshot()
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LabelCardinalityError",
+    "MetricsRegistry",
+    "Span",
+    "current_span",
+    "default_registry",
+    "install",
+    "profile",
+    "recent_spans",
+    "registry",
+    "set_enabled",
+    "slowlog",
+    "snapshot",
+    "snapshot_json",
+    "span",
+    "spans",
+    "to_prometheus",
+    "write_snapshot",
+    "xla_events",
+]

@@ -1,0 +1,109 @@
+"""Exporters: Prometheus text format + JSON snapshot helpers.
+
+The registry's native ``snapshot()`` is the JSON answer; this module adds
+the scrape answer — Prometheus text exposition format 0.0.4, the lingua
+franca every metrics pipeline ingests.  Output is deterministic (metrics
+and series sorted) so diffs and the regex round-trip test in
+``tests/test_obs.py`` are stable.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, Optional
+
+from raft_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    LabelValue,
+    MetricsRegistry,
+    default_registry,
+)
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_OK = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _sanitize(name: str, label: bool = False) -> str:
+    out = re.sub(r"[^a-zA-Z0-9_:]" if not label else r"[^a-zA-Z0-9_]",
+                 "_", name)
+    if not out or not out[0].isalpha() and out[0] != "_":
+        out = "_" + out
+    return out
+
+
+def _escape_value(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _fmt_series(name: str, labels: LabelValue,
+                extra: Optional[Dict[str, str]] = None) -> str:
+    items = [(k, v) for k, v in labels]
+    if extra:
+        items += list(extra.items())
+    if not items:
+        return name
+    body = ",".join(
+        f'{_sanitize(k, label=True)}="{_escape_value(str(v))}"'
+        for k, v in items
+    )
+    return f"{name}{{{body}}}"
+
+
+def _fmt_float(x: float) -> str:
+    if x == float("inf"):
+        return "+Inf"
+    if float(x).is_integer() and abs(x) < 1e15:
+        return str(int(x))
+    return repr(float(x))
+
+
+def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render ``registry`` (default: process registry) as Prometheus text."""
+    reg = registry if registry is not None else default_registry()
+    lines = []
+    for m in sorted(reg.metrics(), key=lambda m: m.name):
+        name = _sanitize(m.name)
+        assert _NAME_OK.match(name)
+        if m.help:
+            lines.append(f"# HELP {name} {_escape_value(m.help)}")
+        lines.append(f"# TYPE {name} {m.kind}")
+        if isinstance(m, (Counter, Gauge)):
+            data = m.collect()
+            for k in sorted(data.keys()):
+                lines.append(f"{_fmt_series(name, k)} {_fmt_float(data[k])}")
+        elif isinstance(m, Histogram):
+            data = m.collect()
+            for k in sorted(data.keys()):
+                d = data[k]
+                cum = 0
+                edges = list(m.buckets) + [float("inf")]
+                for edge, n in zip(edges, d["bucket_counts"]):
+                    cum += n
+                    lines.append(
+                        f"{_fmt_series(name + '_bucket', k, {'le': _fmt_float(edge)})}"
+                        f" {cum}"
+                    )
+                lines.append(
+                    f"{_fmt_series(name + '_sum', k)} {_fmt_float(d['sum'])}"
+                )
+                lines.append(
+                    f"{_fmt_series(name + '_count', k)} {d['count']}"
+                )
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def snapshot_json(registry: Optional[MetricsRegistry] = None,
+                  indent: Optional[int] = None) -> str:
+    """The registry snapshot serialized to a JSON string."""
+    reg = registry if registry is not None else default_registry()
+    return json.dumps(reg.snapshot(), indent=indent, default=str)
+
+
+def write_snapshot(path: str,
+                   registry: Optional[MetricsRegistry] = None) -> None:
+    """Dump a JSON snapshot to ``path`` (atomic-enough single write)."""
+    with open(path, "w") as f:
+        f.write(snapshot_json(registry, indent=2))

@@ -1,0 +1,102 @@
+"""Slow-query log: requests over a latency threshold, with their anatomy.
+
+A p99 regression tells you *that* something is slow; the slow-query log
+tells you *which* requests and *where the time went* — each entry carries
+the span's stage breakdown (queue/pad/dispatch/device) and any attributed
+XLA events, so "p99 doubled" resolves to "requests behind a 12 s compile"
+without re-running traffic under a profiler.
+
+Entries go two places: a bounded in-memory ring (queryable via
+:func:`entries` and merged into registry snapshots) and the ``raft_tpu``
+logger at WARNING (one structured line per slow request), matching the
+reference's RAFT_LOG_WARN-on-degradation idiom (core/logger-inl.hpp).
+
+Threshold: ``RAFT_TPU_SLOW_QUERY_MS`` env var, or :func:`configure`.
+Default 250 ms — generous for an in-memory ANN hit, tight enough to catch
+a hot-path compile.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from raft_tpu.core.logger import child as _child_logger
+from raft_tpu.obs.registry import default_registry
+from raft_tpu.obs.spans import Span
+
+_CAP = 256
+
+_lock = threading.Lock()
+_entries: deque = deque(maxlen=_CAP)
+_threshold_s = float(os.environ.get("RAFT_TPU_SLOW_QUERY_MS", "250")) * 1e-3
+
+
+def configure(threshold_ms: Optional[float]) -> None:
+    """Set the slow threshold; None disables the log entirely."""
+    global _threshold_s
+    _threshold_s = None if threshold_ms is None else float(threshold_ms) * 1e-3
+
+
+def threshold_ms() -> Optional[float]:
+    return None if _threshold_s is None else _threshold_s * 1e3
+
+
+def maybe_record(span: Span, *, latency_s: Optional[float] = None,
+                 detail: Optional[Dict[str, object]] = None) -> bool:
+    """Log ``span`` if its latency crossed the threshold.
+
+    ``latency_s`` overrides the span's own wall time — the batcher passes
+    the worst submit→complete request latency, which includes queue wait
+    the dispatch span can't see.  Returns True when recorded as slow.
+    Callers sit on hot paths: the fast path is one float compare.
+    """
+    if latency_s is None:
+        latency_s = span.duration_s
+    if _threshold_s is None or latency_s is None:
+        return False
+    if latency_s < _threshold_s:
+        return False
+    entry: Dict[str, object] = {
+        "unix_time": time.time(),
+        "latency_ms": latency_s * 1e3,
+        **span.to_dict(),
+    }
+    if detail:
+        entry.update(detail)
+    with _lock:
+        _entries.append(entry)
+    default_registry().counter(
+        "raft_tpu_slow_queries_total",
+        help="requests over the slow threshold",
+    ).inc(span=span.name)
+    stages = ", ".join(
+        f"{k}={v:.1f}ms" for k, v in entry.get("stages_ms", {}).items()
+    )
+    _child_logger("obs.slowlog").warning(
+        "slow query: %s took %.1fms (threshold %.1fms)%s",
+        span.name,
+        latency_s * 1e3,
+        _threshold_s * 1e3,
+        f" [{stages}]" if stages else "",
+    )
+    return True
+
+
+def entries(n: int = 50) -> List[Dict[str, object]]:
+    """Most recent slow entries, newest last."""
+    with _lock:
+        return list(_entries)[-n:]
+
+
+def clear() -> None:
+    with _lock:
+        _entries.clear()
+
+
+def slowlog_snapshot() -> Dict[str, object]:
+    """Provider section for registry snapshots."""
+    return {"threshold_ms": threshold_ms(), "recent": entries(20)}

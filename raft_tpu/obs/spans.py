@@ -1,0 +1,167 @@
+"""Structured spans: what NVTX ranges become when you need to *query* them.
+
+``core.trace.trace_range`` already brackets every public entry point for
+the profiler's benefit; this module makes the same brackets report into
+the metrics registry so the question "where did the milliseconds go"
+has an answer without a Perfetto session attached:
+
+- every range becomes a :class:`Span` (id, parent id, wall time, named
+  stage timings, attributed events) on a thread-local stack;
+- finishing a span feeds ``raft_tpu_span_seconds{span=<name>}`` in the
+  default registry and a bounded ring of recent spans for inspection;
+- :func:`current_span` lets leaf code (the XLA monitoring listener, the
+  batcher's stage timers) attach data to whatever operation is running,
+  with no plumbing through call signatures — the zero call-site-churn
+  property the reference gets from NVTX's implicit nesting.
+
+Spans are intentionally *not* cross-thread: a request handed from the
+submitting thread to the batcher's worker starts a fresh root span there,
+and queue-wait crosses the gap as an explicit stage measurement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+from raft_tpu.obs.registry import default_registry
+
+#: ring of recently finished root spans (tests / debugging / slow log)
+_RECENT_CAP = 512
+
+_ids = itertools.count(1)  # itertools.count.__next__ is atomic in CPython
+_tls = threading.local()
+_recent_lock = threading.Lock()
+_recent: deque = deque(maxlen=_RECENT_CAP)
+
+_disabled = bool(os.environ.get("RAFT_TPU_OBS_DISABLED"))
+
+
+def set_enabled(enabled: bool) -> None:
+    """Global kill-switch (also: RAFT_TPU_OBS_DISABLED=1 at import)."""
+    global _disabled
+    _disabled = not enabled
+
+
+def enabled() -> bool:
+    return not _disabled
+
+
+class Span:
+    """One timed operation. Mutable while open; frozen facts after close."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "t_start", "t_end",
+        "stages", "events",
+    )
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int]):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t_start = time.perf_counter()
+        self.t_end: Optional[float] = None
+        #: named sub-timings in seconds (queue/pad/dispatch/device, ...)
+        self.stages: Dict[str, float] = {}
+        #: attributed event tallies (xla_compiles, xla_compile_seconds, ...)
+        self.events: Dict[str, float] = {}
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        if self.t_end is None:
+            return None
+        return self.t_end - self.t_start
+
+    def add_stage(self, name: str, seconds: float) -> None:
+        self.stages[name] = self.stages.get(name, 0.0) + float(seconds)
+
+    def add_event(self, name: str, value: float = 1.0) -> None:
+        self.events[name] = self.events.get(name, 0.0) + float(value)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "duration_ms": (
+                None if self.duration_s is None else self.duration_s * 1e3
+            ),
+            "stages_ms": {k: v * 1e3 for k, v in self.stages.items()},
+            "events": dict(self.events),
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        d = self.duration_s
+        return (
+            f"<Span {self.name} id={self.span_id} "
+            f"{'open' if d is None else f'{d * 1e3:.3f}ms'}>"
+        )
+
+
+def _stack() -> List[Span]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_span() -> Optional[Span]:
+    """Innermost open span on this thread, or None."""
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[Optional[Span]]:
+    """Open a child of the current span (or a root).  Yields the Span, or
+    None when observability is globally disabled."""
+    if _disabled:
+        yield None
+        return
+    stack = _stack()
+    parent = stack[-1] if stack else None
+    sp = Span(name, next(_ids), parent.span_id if parent else None)
+    stack.append(sp)
+    try:
+        yield sp
+    finally:
+        sp.t_end = time.perf_counter()
+        stack.pop()
+        _record_finished(sp, parent)
+
+
+def _record_finished(sp: Span, parent: Optional[Span]) -> None:
+    reg = default_registry()
+    try:
+        reg.histogram(
+            "raft_tpu_span_seconds",
+            help="wall time per traced operation",
+        ).observe(sp.duration_s, span=sp.name)
+    except Exception:
+        # span names are static strings in practice; a pathological dynamic
+        # name tripping the cardinality cap must not break the traced API
+        pass
+    if parent is not None:
+        # roll attributed events up so root spans carry the whole story
+        for k, v in sp.events.items():
+            parent.add_event(k, v)
+    else:
+        with _recent_lock:
+            _recent.append(sp)
+
+
+def recent_spans(n: int = 50) -> List[Dict[str, object]]:
+    """Most recent finished root spans, newest last (JSON-safe)."""
+    with _recent_lock:
+        items = list(_recent)[-n:]
+    return [sp.to_dict() for sp in items]
+
+
+def spans_snapshot() -> Dict[str, object]:
+    """Provider section for registry snapshots."""
+    return {"recent": recent_spans(20)}

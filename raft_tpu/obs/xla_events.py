@@ -1,0 +1,141 @@
+"""jax.monitoring → registry bridge: compiles, cache hits, transfers.
+
+``serve.metrics`` counts exactly one thing (backend compiles, for the
+zero-recompile contract).  Production debugging needs the rest of the
+story: how *long* compiles took, whether the executable came from the
+persistent cache, and what host↔device transfers cost — attributed to
+the operation that caused them, because "something compiled" is useless
+while "``serve.batch`` compiled for 12 s at 14:03" is a pager message.
+
+Event vocabulary (jax 0.4.x, matched by substring so newer versions'
+renames degrade to the generic family instead of vanishing):
+
+- ``/jax/core/compile/backend_compile_duration``  → compile family
+- ``/jax/core/compile/jaxpr_trace_duration`` etc. → trace family
+- ``/jax/compilation_cache/cache_hits|cache_misses`` → cache family
+- anything containing ``transfer``                → transfer family
+
+Listener callbacks tolerate extra positional/keyword arguments: newer jax
+versions append context args to duration listeners, and a signature
+mismatch there would silently disable every listener in the process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from raft_tpu.obs import spans as _spans
+from raft_tpu.obs.registry import MetricsRegistry, default_registry
+
+_install_lock = threading.Lock()
+_installed = False
+
+#: compile-duration histogram ladder: 10 ms .. ~160 s (seconds)
+_COMPILE_BUCKETS = tuple(0.01 * (2.0 ** i) for i in range(15))
+
+
+def _family(event: str) -> Optional[str]:
+    if "backend_compile" in event:
+        return "backend_compile"
+    if "/compile/" in event or event.endswith("_compile_duration"):
+        return "compile_stage"
+    if "cache_hit" in event:
+        return "cache_hit"
+    if "cache_miss" in event:
+        return "cache_miss"
+    if "compilation_cache" in event:
+        return "cache_other"
+    if "transfer" in event:
+        return "transfer"
+    return None
+
+
+def _attribute(reg: MetricsRegistry, family: str, seconds: Optional[float]
+               ) -> None:
+    """Book one event against the innermost open span (if any)."""
+    sp = _spans.current_span()
+    span_name = sp.name if sp is not None else "(no span)"
+    if family == "backend_compile":
+        reg.counter(
+            "raft_tpu_xla_compiles_total",
+            help="XLA backend compiles, by enclosing traced span",
+        ).inc(span=span_name)
+        if seconds is not None:
+            reg.histogram(
+                "raft_tpu_xla_compile_seconds",
+                help="XLA backend compile durations",
+                buckets=_COMPILE_BUCKETS,
+            ).observe(seconds)
+        if sp is not None:
+            sp.add_event("xla_compiles")
+            if seconds is not None:
+                sp.add_event("xla_compile_seconds", seconds)
+    elif family in ("cache_hit", "cache_miss"):
+        reg.counter(
+            "raft_tpu_xla_executable_cache_total",
+            help="persistent compilation cache hits/misses",
+        ).inc(result=("hit" if family == "cache_hit" else "miss"))
+        if sp is not None:
+            sp.add_event(f"xla_cache_{family.split('_')[1]}")
+    elif family == "transfer":
+        reg.counter(
+            "raft_tpu_xla_transfer_events_total",
+            help="host<->device transfer events",
+        ).inc(span=span_name)
+        if seconds is not None:
+            reg.histogram(
+                "raft_tpu_xla_transfer_seconds",
+                help="host<->device transfer durations",
+            ).observe(seconds)
+        if sp is not None:
+            sp.add_event("xla_transfers")
+    elif family == "compile_stage":
+        # jaxpr trace / mlir lowering durations: aggregate only
+        reg.histogram(
+            "raft_tpu_xla_lowering_seconds",
+            help="jaxpr trace + lowering stage durations",
+        ).observe(seconds if seconds is not None else 0.0)
+
+
+def _on_event_duration(event: str, duration: float, *args, **kwargs) -> None:
+    # *args/**kwargs: newer jax passes extra context positionally; a strict
+    # 2-arg signature would raise inside jax and break all listeners
+    if not _spans.enabled():
+        return
+    fam = _family(str(event))
+    if fam is not None:
+        _attribute(default_registry(), fam, float(duration))
+
+
+def _on_event(event: str, *args, **kwargs) -> None:
+    if not _spans.enabled():
+        return
+    fam = _family(str(event))
+    if fam is not None:
+        _attribute(default_registry(), fam, None)
+
+
+def install(force: bool = False) -> bool:
+    """Register the monitoring listeners (idempotent, process-wide).
+
+    Returns True when the listeners are active after the call.  ``force``
+    re-registers after a ``jax.monitoring.clear_event_listeners()`` (which
+    tests use; jax offers no unregister API).
+    """
+    global _installed
+    with _install_lock:
+        if _installed and not force:
+            return True
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+        jax.monitoring.register_event_listener(_on_event)
+        _installed = True
+        return True
+
+
+def installed() -> bool:
+    return _installed

@@ -12,8 +12,10 @@ indexes that change while being served.  Five pieces:
   upserts, merged through one ``select_k``.
 - :mod:`~raft_tpu.serve.registry` — named, versioned indexes with atomic
   hot-swap and snapshot/restore.
-- :mod:`~raft_tpu.serve.metrics` — QPS / p50 / p99 / batch-fill and a
-  *real* recompile counter (jax.monitoring backend-compile events).
+- :mod:`~raft_tpu.serve.metrics` — QPS / p50 / p99 / batch-fill, the
+  queue/pad/dispatch/device stage breakdown, and a *real* recompile
+  counter (jax.monitoring backend-compile events); every instance also
+  reports into the process-wide :mod:`raft_tpu.obs` registry.
 - :mod:`~raft_tpu.serve.replica` — query-sharded multi-chip dispatch over
   a replicated index (comms/ mesh).
 

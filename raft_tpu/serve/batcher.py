@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from raft_tpu.core.trace import trace_range
+from raft_tpu.obs import slowlog
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
 
 # search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k])
@@ -174,6 +175,7 @@ class MicroBatcher:
                 req.future.set_exception(
                     RuntimeError("MicroBatcher stopped before dispatch")
                 )
+        self.metrics.close()
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -284,6 +286,9 @@ class MicroBatcher:
     def _dispatch_locked(self, batch: List[_Request]) -> None:
         if not batch:
             return
+        t_start = time.perf_counter()
+        # queue-wait ends the moment the batch is picked up: submit → here
+        queue_waits = [t_start - r.t_submit for r in batch]
         n = sum(r.rows.shape[0] for r in batch)
         bucket = self.bucket_for(n)
         padded = np.zeros((bucket, self.dim), dtype=np.float32)
@@ -292,11 +297,23 @@ class MicroBatcher:
             m = req.rows.shape[0]
             padded[off : off + m] = req.rows
             off += m
+        t_pad = time.perf_counter() - t_start
+        sp = None
         try:
             c0 = compile_count()
-            with trace_range("serve.batch"):
+            with trace_range("serve.batch") as sp:
+                t0 = time.perf_counter()
+                # dispatch: host-side tracing + enqueue of the executable
                 dist, ids = self._search_fn(jax.numpy.asarray(padded))
+                t1 = time.perf_counter()
+                # device: waiting for the result to materialize
                 jax.block_until_ready((dist, ids))
+                t2 = time.perf_counter()
+                if sp is not None:
+                    sp.add_stage("queue", max(queue_waits, default=0.0))
+                    sp.add_stage("pad", t_pad)
+                    sp.add_stage("dispatch", t1 - t0)
+                    sp.add_stage("device", t2 - t1)
             compiles = compile_count() - c0
             dist = np.asarray(dist)
             ids = np.asarray(ids)
@@ -312,7 +329,26 @@ class MicroBatcher:
             req.future.set_result((dist[off : off + m], ids[off : off + m]))
             off += m
             lats.append(done - req.t_submit)
-        self.metrics.record_batch(n, bucket, lats, compiles)
+        self.metrics.record_batch(
+            n, bucket, lats, compiles,
+            stages={
+                "queue": queue_waits,
+                "pad": (t_pad,),
+                "dispatch": (t1 - t0,),
+                "device": (t2 - t1,),
+            },
+        )
+        if sp is not None:
+            slowlog.maybe_record(
+                sp,
+                latency_s=max(lats, default=0.0),
+                detail={
+                    "index": self.metrics.name,
+                    "requests": len(batch),
+                    "bucket": bucket,
+                    "compiles": compiles,
+                },
+            )
 
 
 def _squeeze_result(inner: Future, outer: Future) -> None:

@@ -12,7 +12,14 @@ the serving path.  A non-zero ``recompiles`` after warmup is a bug, and
 
 Latency keeps a bounded reservoir (last ``_RESERVOIR`` request latencies)
 — percentile math stays O(reservoir), not O(uptime).  QPS is measured over
-the same window from completion timestamps.
+the same window from completion timestamps.  The batcher additionally
+reports *stage* reservoirs (queue-wait / pad / dispatch / device), so a
+p99 excursion decomposes into "where" without a profiler.
+
+:class:`ServingMetrics` is also a :mod:`raft_tpu.obs` registry client:
+named instances mirror requests/batches/recompiles into process-wide
+counters and request/stage latencies into labeled histograms, and appear
+as a ``serve.<name>`` provider section in ``obs.snapshot()``.
 """
 
 from __future__ import annotations
@@ -20,23 +27,37 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Iterable, Mapping, Optional
 
 import numpy as np
 
+from raft_tpu import obs
+
 _RESERVOIR = 4096
+
+#: stage names the batcher reports, in display order
+STAGES = ("queue", "pad", "dispatch", "device")
 
 # ---- process-wide XLA compile counter -------------------------------------
 
 _compile_count = 0
 _listener_installed = False
 _listener_lock = threading.Lock()
+# jax invokes duration listeners from whatever thread triggered the compile;
+# the count must increment under a lock (int += is not atomic across the
+# read-modify-write) — and NOT _listener_lock, which install_compile_listener
+# holds while jax might already be delivering events
+_count_lock = threading.Lock()
 
 
-def _on_event_duration(name: str, duration: float, **kwargs) -> None:
+def _on_event_duration(name: str, duration: float, *args, **kwargs) -> None:
+    # *args soaks up extra positional context newer jax versions pass to
+    # duration listeners; a strict (name, duration) signature would raise
+    # inside jax.monitoring and silently kill the listener
     global _compile_count
     if name == "/jax/core/compile/backend_compile_duration":
-        _compile_count += 1
+        with _count_lock:
+            _compile_count += 1
 
 
 def install_compile_listener() -> None:
@@ -54,25 +75,48 @@ def install_compile_listener() -> None:
 def compile_count() -> int:
     """Total XLA backend compiles observed in this process so far."""
     install_compile_listener()
-    return _compile_count
+    with _count_lock:
+        return _compile_count
 
 
 class ServingMetrics:
-    """Per-service request/batch counters + latency reservoir.
+    """Per-service request/batch counters + latency reservoirs.
 
     Thread-safe; the batcher's worker thread records, any thread snapshots.
+    With a ``name`` the instance doubles as an obs registry client: the
+    same numbers flow into ``raft_tpu_serve_*`` counters/histograms labeled
+    ``index=<name>`` and the instance registers a ``serve.<name>``
+    provider so ``obs.snapshot()`` carries the full serving picture.
     """
 
-    def __init__(self, reservoir: int = _RESERVOIR):
+    def __init__(self, reservoir: int = _RESERVOIR,
+                 name: Optional[str] = None):
         self._lock = threading.Lock()
         self._latencies = deque(maxlen=reservoir)   # seconds, per request
         self._done_ts = deque(maxlen=reservoir)     # completion timestamps
+        self._stage_lat: Dict[str, deque] = {
+            s: deque(maxlen=reservoir) for s in STAGES
+        }
+        self.name = name
         self.requests = 0
         self.batches = 0
         self.recompiles = 0        # compiles attributed to serve dispatches
         self.warmup_compiles = 0   # compiles spent in explicit warmup
         self._fill_real = 0        # sum of real rows over all batches
         self._fill_padded = 0      # sum of padded bucket rows
+        if name is not None:
+            obs.default_registry().register_provider(
+                f"serve.{name}", self.snapshot
+            )
+
+    def close(self) -> None:
+        """Detach from the obs registry (batcher teardown).  Only removes
+        the provider if it is still this instance's — a hot-replaced
+        batcher's teardown must not detach its successor."""
+        if self.name is not None:
+            obs.default_registry().unregister_provider(
+                f"serve.{self.name}", expected=self.snapshot
+            )
 
     # -- recording ----------------------------------------------------------
     def record_batch(
@@ -81,9 +125,12 @@ class ServingMetrics:
         bucket_rows: int,
         latencies_s,
         compiles: int,
+        stages: Optional[Mapping[str, Iterable[float]]] = None,
     ) -> None:
         """One dispatched batch: ``latencies_s`` holds one submit→complete
-        latency per coalesced request (queue wait included)."""
+        latency per coalesced request (queue wait included); ``stages``
+        maps stage name → iterable of per-batch (or per-request, for
+        ``queue``) stage durations in seconds."""
         now = time.perf_counter()
         with self._lock:
             self.requests += len(latencies_s)
@@ -94,6 +141,47 @@ class ServingMetrics:
             for lat in latencies_s:
                 self._latencies.append(lat)
                 self._done_ts.append(now)
+            if stages:
+                for s, vals in stages.items():
+                    dq = self._stage_lat.setdefault(
+                        s, deque(maxlen=self._latencies.maxlen)
+                    )
+                    for v in vals:
+                        dq.append(float(v))
+        self._mirror_batch(n_real_rows, latencies_s, compiles, stages)
+
+    def _mirror_batch(self, n_real_rows, latencies_s, compiles, stages
+                      ) -> None:
+        """Feed the obs registry (no-op for anonymous instances)."""
+        if self.name is None:
+            return
+        reg = obs.default_registry()
+        label = {"index": self.name}
+        reg.counter(
+            "raft_tpu_serve_requests_total", help="served requests"
+        ).inc(len(latencies_s), **label)
+        reg.counter(
+            "raft_tpu_serve_batches_total", help="dispatched batches"
+        ).inc(**label)
+        if compiles:
+            reg.counter(
+                "raft_tpu_serve_recompiles_total",
+                help="hot-path XLA compiles (should stay 0 after warmup)",
+            ).inc(compiles, **label)
+        lat_h = reg.histogram(
+            "raft_tpu_serve_request_seconds",
+            help="submit-to-complete request latency",
+        )
+        for lat in latencies_s:
+            lat_h.observe(lat, **label)
+        if stages:
+            st_h = reg.histogram(
+                "raft_tpu_serve_stage_seconds",
+                help="per-stage serving latency (queue/pad/dispatch/device)",
+            )
+            for s, vals in stages.items():
+                for v in vals:
+                    st_h.observe(v, stage=s, **label)
 
     def record_warmup(self, compiles: int) -> None:
         with self._lock:
@@ -110,6 +198,10 @@ class ServingMetrics:
         with self._lock:
             lat = np.asarray(self._latencies, dtype=np.float64)
             ts = np.asarray(self._done_ts, dtype=np.float64)
+            stage_arrs = {
+                s: np.asarray(dq, dtype=np.float64)
+                for s, dq in self._stage_lat.items()
+            }
             out: Dict[str, object] = {
                 "requests": self.requests,
                 "batches": self.batches,
@@ -129,6 +221,14 @@ class ServingMetrics:
             out["qps"] = float(lat.size / span) if span > 0 else None
         else:
             out["p50_ms"] = out["p99_ms"] = out["qps"] = None
+        out["stages"] = {
+            s: {
+                "p50_ms": float(np.percentile(a, 50) * 1e3),
+                "p99_ms": float(np.percentile(a, 99) * 1e3),
+            }
+            for s, a in stage_arrs.items()
+            if a.size
+        }
         return out
 
 

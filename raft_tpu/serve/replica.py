@@ -22,6 +22,7 @@ bucket, independent of device count.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -87,9 +88,18 @@ def make_replicated_search(comms: Comms, search_fn):
         q_pad = -(-q // size) * size
         if q_pad != q:
             queries = jnp.pad(queries, ((0, q_pad - q), (0, 0)))
+        t0 = time.perf_counter()
         qs = jax.device_put(queries, NamedSharding(mesh, P(axis, None)))
-        with trace_range("serve.replicated_search"):
+        with trace_range("serve.replicated_search") as sp:
+            t1 = time.perf_counter()
             v, i = _sharded(k)(qs)
+            t2 = time.perf_counter()
+            if sp is not None:
+                # shard: host-side pad + device_put of the query shards;
+                # dispatch: tracing/enqueue of the replicated executable
+                # (device wait lands in the caller's block_until_ready)
+                sp.add_stage("shard", t1 - t0)
+                sp.add_stage("dispatch", t2 - t1)
         return v[:q], i[:q]
 
     return run

@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from raft_tpu import obs
 from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
@@ -55,6 +56,10 @@ class SearchService:
         start: bool = True,
     ):
         install_compile_listener()
+        # full pipeline: XLA event attribution + span/slowlog snapshot
+        # sections — the service is the component that promises "where did
+        # the milliseconds go" has an answer
+        obs.install()
         self.registry = registry if registry is not None else IndexRegistry()
         self.k = int(k)
         self.min_bucket = min_bucket
@@ -88,7 +93,7 @@ class SearchService:
                 min_bucket=self.min_bucket,
                 max_batch=self.max_batch,
                 max_delay_ms=self.max_delay_ms,
-                metrics=ServingMetrics(),
+                metrics=ServingMetrics(name=name),
                 start=self._start,
             )
             self._batchers[name] = batcher
@@ -164,7 +169,12 @@ class SearchService:
 
     # -- observability -------------------------------------------------------
     def stats(self, name: str) -> Dict[str, object]:
-        """Metrics snapshot + index version/size for one served name."""
+        """Metrics snapshot + index version/size for one served name.
+
+        Includes the per-stage latency breakdown under ``stages`` —
+        queue-wait / pad / dispatch / device p50+p99 — so a p99 excursion
+        decomposes without a profiler session.
+        """
         index, version = self.registry.get_versioned(name)
         out = self._batcher(name).metrics.snapshot()
         deleted, side = index.pending_mutations()
@@ -177,6 +187,24 @@ class SearchService:
             side_rows=side,
         )
         return out
+
+    def metrics(self) -> Dict[str, object]:
+        """The whole observability picture in one JSON-safe dict.
+
+        ``indexes`` holds each served name's :meth:`stats` (request p50/p99
+        + per-stage breakdown); ``registry`` is the process-wide
+        :func:`raft_tpu.obs.snapshot` — span histograms, XLA compile events
+        attributed to the span that caused them, cache hit/miss counts,
+        the slow-query log, and each index's ``serve.<name>`` section.
+        """
+        return {
+            "indexes": {n: self.stats(n) for n in self.names()},
+            "registry": obs.snapshot(),
+        }
+
+    def prometheus(self) -> str:
+        """The process metrics registry in Prometheus text format."""
+        return obs.to_prometheus()
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:

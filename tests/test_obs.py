@@ -1,0 +1,376 @@
+"""raft_tpu.obs: registry correctness (histograms vs numpy, cardinality
+cap, thread-safety), Prometheus text round-trip, span structure + XLA
+compile attribution, slow-query log, and the serve integration — the
+zero-recompile contract with obs enabled and the <5% hot-path overhead
+guard."""
+
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.core.trace import trace_range
+from raft_tpu.obs.registry import MetricsRegistry, LabelCardinalityError
+
+
+# ---------------------------------------------------------------------------
+# registry: histograms
+
+
+def test_histogram_buckets_match_numpy():
+    reg = MetricsRegistry()
+    edges = [0.001, 0.01, 0.1, 1.0]
+    h = reg.histogram("h_t", buckets=edges)
+    rng = np.random.default_rng(0)
+    vals = rng.gamma(2.0, 0.02, size=500)  # straddles several buckets
+    for v in vals:
+        h.observe(float(v))
+    data = h.collect()[()]
+    # numpy reference: counts per (prev, edge] interval + +Inf overflow
+    ref = np.histogram(vals, bins=[-np.inf] + edges + [np.inf])[0]
+    np.testing.assert_array_equal(data["bucket_counts"], ref)
+    assert data["count"] == 500
+    assert data["sum"] == pytest.approx(vals.sum(), rel=1e-9)
+
+
+def test_histogram_percentiles_match_numpy():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_p")
+    rng = np.random.default_rng(1)
+    vals = rng.random(1000)
+    for v in vals:
+        h.observe(float(v), kind="x")
+    for q in (50, 90, 99):
+        assert h.percentile(q, kind="x") == pytest.approx(
+            np.percentile(vals, q)
+        )
+    assert h.percentile(50, kind="missing") is None
+
+
+def test_histogram_reservoir_stays_bounded():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_b")
+    for i in range(5000):
+        h.observe(float(i))
+    d = h.collect()[()]
+    assert d["count"] == 5000                  # true count keeps going
+    assert d["reservoir"].size <= 2048         # raw storage is bounded
+
+
+# ---------------------------------------------------------------------------
+# registry: label cardinality cap
+
+
+def test_label_cardinality_cap_raises():
+    reg = MetricsRegistry(max_series=8)
+    c = reg.counter("runaway")
+    for i in range(8):
+        c.inc(request_id=str(i))
+    with pytest.raises(LabelCardinalityError):
+        c.inc(request_id="one-too-many")
+    # the offending series was NOT materialized
+    assert len(c.series()) == 8
+    # existing series still work
+    c.inc(request_id="3")
+    assert c.value(request_id="3") == 2.0
+
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(2.5, op="x")
+    assert c.value() == 1.0 and c.value(op="x") == 2.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g")
+    g.set(7, depth="q")
+    g.inc(3, depth="q")
+    assert g.value(depth="q") == 10.0
+    with pytest.raises(TypeError):
+        reg.gauge("c")  # name already a counter
+
+
+# ---------------------------------------------------------------------------
+# registry: concurrent record/snapshot
+
+
+def test_concurrent_record_and_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("hits")
+    h = reg.histogram("lat")
+    n_threads, n_each = 8, 2000
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(n_each):
+                c.inc(worker=str(tid % 4))
+                h.observe(i * 1e-4, worker=str(tid % 4))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(50):
+                snap = reg.snapshot()
+                assert "counters" in snap
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ] + [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[0]
+    total = sum(c.collect().values())
+    assert total == n_threads * n_each       # no lost increments
+    hist_total = sum(d["count"] for d in h.collect().values())
+    assert hist_total == n_threads * n_each
+
+
+# ---------------------------------------------------------------------------
+# Prometheus export: regex round-trip
+
+_SERIES_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+    r" (\+Inf|-?[0-9.e+-]+)$"
+)
+
+
+def test_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("req_total", help="requests").inc(3, index="a b")
+    reg.counter("req_total").inc(4, index='quo"te')
+    reg.gauge("depth").set(2.5)
+    h = reg.histogram("lat_seconds", buckets=[0.01, 0.1])
+    for v in (0.005, 0.05, 0.5):
+        h.observe(v, index="a")
+    text = obs.to_prometheus(reg)
+
+    parsed = {}
+    types = {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split()
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        assert m, f"unparseable series line: {line!r}"
+        parsed[(m.group(1), m.group(2) or "")] = m.group(3)
+
+    assert types == {
+        "req_total": "counter", "depth": "gauge", "lat_seconds": "histogram",
+    }
+    # values survive the round trip (label values escaped, numbers exact)
+    assert parsed[("req_total", 'index="a b"')] == "3"
+    assert parsed[("req_total", 'index="quo\\"te"')] == "4"
+    assert parsed[("depth", "")] == "2.5"
+    # histogram: buckets are cumulative and +Inf equals _count
+    assert parsed[("lat_seconds_bucket", 'index="a",le="0.01"')] == "1"
+    assert parsed[("lat_seconds_bucket", 'index="a",le="0.1"')] == "2"
+    assert parsed[("lat_seconds_bucket", 'index="a",le="+Inf"')] == "3"
+    assert parsed[("lat_seconds_count", 'index="a"')] == "3"
+    assert float(parsed[("lat_seconds_sum", 'index="a"')]) == pytest.approx(
+        0.555
+    )
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+def test_span_nesting_and_event_rollup():
+    with obs.span("outer") as outer:
+        assert obs.current_span() is outer
+        with obs.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            inner.add_event("xla_compiles", 2)
+            inner.add_stage("work", 0.25)
+        # child events roll up to the (root) parent
+        assert outer.events.get("xla_compiles") == 2
+    assert obs.current_span() is None
+    assert outer.duration_s is not None and outer.duration_s >= 0
+    recent = obs.recent_spans(5)
+    assert recent and recent[-1]["name"] == "outer"
+    assert recent[-1]["parent_id"] is None
+
+
+def test_trace_range_yields_span_and_feeds_registry():
+    h = obs.default_registry().histogram("raft_tpu_span_seconds")
+    before = sum(d["count"] for d in h.collect().values())
+    with trace_range("obs_test.range") as sp:
+        assert sp is not None and sp.name == "obs_test.range"
+    after = sum(d["count"] for d in h.collect().values())
+    assert after == before + 1
+
+
+def test_xla_compile_attributed_to_span():
+    obs.install()
+    c = obs.default_registry().counter("raft_tpu_xla_compiles_total")
+    before = c.value(span="obs_test.compile_here")
+    with obs.span("obs_test.compile_here") as sp:
+        # fresh shape => guaranteed backend compile
+        x = jnp.ones((13, 17), jnp.float32)
+        jax.block_until_ready(jax.jit(lambda a: a * 2.0 + 1.0)(x))
+    assert c.value(span="obs_test.compile_here") >= before + 1
+    assert sp.events.get("xla_compiles", 0) >= 1
+
+
+def test_obs_disable_enable():
+    obs.set_enabled(False)
+    try:
+        with obs.span("dead") as sp:
+            assert sp is None
+        with trace_range("dead.range") as sp2:
+            assert sp2 is None
+    finally:
+        obs.set_enabled(True)
+    with obs.span("alive") as sp:
+        assert sp is not None
+
+
+# ---------------------------------------------------------------------------
+# slow-query log
+
+
+def test_slowlog_records_over_threshold():
+    from raft_tpu.obs import slowlog
+
+    old = slowlog.threshold_ms()
+    slowlog.configure(0.0)  # everything is slow
+    try:
+        slowlog.clear()
+        with obs.span("slow.op") as sp:
+            sp.add_stage("dispatch", 0.001)
+            time.sleep(0.002)
+        assert slowlog.maybe_record(sp, detail={"bucket": 4})
+        ent = slowlog.entries()[-1]
+        assert ent["name"] == "slow.op"
+        assert ent["bucket"] == 4
+        assert "dispatch" in ent["stages_ms"]
+        snap = slowlog.slowlog_snapshot()
+        assert snap["threshold_ms"] == 0.0 and snap["recent"]
+    finally:
+        slowlog.configure(old)
+        slowlog.clear()
+
+
+def test_slowlog_fast_path_skips():
+    from raft_tpu.obs import slowlog
+
+    old = slowlog.threshold_ms()
+    slowlog.configure(10_000.0)
+    try:
+        slowlog.clear()
+        with obs.span("fast.op") as sp:
+            pass
+        assert not slowlog.maybe_record(sp)
+        assert not slowlog.entries()
+    finally:
+        slowlog.configure(old)
+
+
+# ---------------------------------------------------------------------------
+# serve integration: contract + overhead
+
+
+@pytest.fixture(scope="module")
+def served():
+    from raft_tpu import serve
+    from raft_tpu.neighbors import brute_force
+
+    rng = np.random.default_rng(2)
+    # Deliberately distinct shapes (d=28, k=4) from tests/test_serve.py's
+    # corpus: both suites run in one process, and identical shapes would
+    # let this fixture's warmup pre-populate the jit cache, making
+    # test_serve's warmup_compiles assertion observe zero backend compiles.
+    x = rng.random((400, 28), dtype=np.float32)
+    q = rng.random((16, 28), dtype=np.float32)
+    svc = serve.SearchService(k=4, min_bucket=1, max_batch=8)
+    svc.add_index("obs", serve.MutableIndex(brute_force.build(x)),
+                  warmup=True)
+    yield svc, q
+    svc.stop()
+
+
+def test_zero_recompile_contract_with_obs_enabled(served):
+    svc, q = served
+    assert obs.spans.enabled()          # obs genuinely on for this test
+    for i in range(16):
+        d, ids = svc.search("obs", q[i % len(q)])
+        assert ids.shape == (4,)
+    st = svc.stats("obs")
+    assert st["recompiles"] == 0, (
+        f"obs instrumentation leaked shapes: {st['recompiles']} recompiles"
+    )
+    # the per-stage breakdown is present and sane
+    stages = st["stages"]
+    assert set(stages) >= {"queue", "pad", "dispatch", "device"}
+    for s in stages.values():
+        assert s["p99_ms"] >= s["p50_ms"] >= 0.0
+
+
+def test_service_metrics_merges_registry_and_prometheus(served):
+    svc, q = served
+    svc.search("obs", q[0])
+    m = svc.metrics()
+    assert "obs" in m["indexes"]
+    reg = m["registry"]
+    assert "serve.obs" in reg                       # provider section
+    assert reg["serve.obs"]["requests"] >= 1
+    assert "raft_tpu_serve_request_seconds" in reg["histograms"]
+    # compile events attributed to spans (warmup compiled under a span)
+    compiles = reg["counters"].get("raft_tpu_xla_compiles_total", {})
+    assert any(k.startswith("span=") for k in compiles), compiles
+    assert "spans" in reg and "slow_queries" in reg
+    text = svc.prometheus()
+    assert "# TYPE raft_tpu_serve_request_seconds histogram" in text
+    assert "raft_tpu_serve_requests_total" in text
+
+
+def test_obs_overhead_under_5pct_of_batch_latency(served):
+    """The registry work a batch performs must be small vs the dispatch.
+
+    Measures the actual per-batch recording cost (ServingMetrics.record_batch
+    incl. the obs mirror: counters + request/stage histograms) against the
+    measured batch latency on this machine, with a 5% budget.
+    """
+    from raft_tpu.serve.metrics import ServingMetrics
+
+    svc, q = served
+    # measured batch latency: median over real dispatches through the service
+    lats = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        svc.search("obs", q[0])
+        lats.append(time.perf_counter() - t0)
+    batch_s = float(np.median(lats))
+
+    sm = ServingMetrics(name="overhead_probe")
+    stages = {
+        "queue": (1e-3,), "pad": (1e-5,),
+        "dispatch": (1e-3,), "device": (1e-4,),
+    }
+    n_iter = 300
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        sm.record_batch(1, 1, [1e-3], 0, stages=stages)
+    per_batch_s = (time.perf_counter() - t0) / n_iter
+    sm.close()
+    assert per_batch_s < 0.05 * batch_s, (
+        f"obs records {per_batch_s * 1e6:.1f}us/batch vs batch "
+        f"{batch_s * 1e3:.2f}ms — over the 5% budget"
+    )
