@@ -117,6 +117,22 @@ def probe_compile_cache() -> bool:
     return True
 
 
+def _emit(payload: dict) -> None:
+    """Print the one BENCH JSON line and drop the schema-versioned record
+    artifact next to it (``RAFT_TPU_BENCH_RECORD`` overrides the path,
+    ``-`` suppresses).  The record write is best-effort — the printed line
+    is the contract, the artifact is what ``bench.py compare`` diffs."""
+    print(json.dumps(payload))
+    try:
+        from raft_tpu.bench.export import write_bench_record
+
+        path = write_bench_record(payload)
+        if path:
+            print(f"bench record written to {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — never fail the bench line
+        print(f"bench record not written: {e}", file=sys.stderr)
+
+
 def timeit(fn, *args, warmup=2, iters=5):
     import jax
 
@@ -143,6 +159,11 @@ def main() -> None:
             sys.exit(2)
         run_leg(sys.argv[idx + 1])
         return
+    if "compare" in sys.argv[1:]:
+        from raft_tpu.bench.export import compare_main
+
+        idx = sys.argv.index("compare")
+        sys.exit(compare_main(sys.argv[idx + 1:]))
     if "serve" in sys.argv[1:]:
         run_serve_leg()
         return
@@ -298,9 +319,9 @@ def run_leg(leg: str) -> None:
             break
         fn = make_search(n_probes)
         _, ids = fn(queries)
-        hits = np.mean([
-            len(set(np.asarray(ids)[i]) & set(gt_ids[i])) / k for i in range(n_q)
-        ])
+        from raft_tpu.stats import recall_at_k
+
+        hits = recall_at_k(np.asarray(ids), gt_ids)
         if hits >= 0.95:
             chosen = (n_probes, float(hits), fn)
             break
@@ -364,32 +385,30 @@ def run_leg(leg: str) -> None:
     qps = n_q / t_ours
     exact_qps = n_q / t_exact
 
-    print(
-        json.dumps(
-            {
-                # keep the r1/r2 metric-name format (q1k etc.) when n_q is
-                # a whole number of thousands so history stays comparable;
-                # the recall95 suffix is only claimed when the operating
-                # point actually reached it (deadline/exhaustion exits
-                # keep best-so-far and must not mislabel)
-                "metric": (
-                    f"ivf_pq_qps_deep{n // 1000}k_q"
-                    + (f"{n_q // 1000}k" if n_q % 1000 == 0 else f"{n_q}")
-                    + ("_k10_recall95" if recall >= 0.95 else "_k10_bestrecall")
-                ),
-                "value": round(qps, 1),
-                "unit": "queries/s",
-                "vs_baseline": round(qps / exact_qps, 3),
-                "platform": platform,
-                "recall": round(recall, 4),
-                "n_probes": n_probes,
-                "strategy": strategy,
-                "pallas": pallas_used,
-                "build_s": round(build_s, 1),
-                "exact_qps": round(exact_qps, 1),
-                "n": n,
-            }
-        )
+    _emit(
+        {
+            # keep the r1/r2 metric-name format (q1k etc.) when n_q is
+            # a whole number of thousands so history stays comparable;
+            # the recall95 suffix is only claimed when the operating
+            # point actually reached it (deadline/exhaustion exits
+            # keep best-so-far and must not mislabel)
+            "metric": (
+                f"ivf_pq_qps_deep{n // 1000}k_q"
+                + (f"{n_q // 1000}k" if n_q % 1000 == 0 else f"{n_q}")
+                + ("_k10_recall95" if recall >= 0.95 else "_k10_bestrecall")
+            ),
+            "value": round(qps, 1),
+            "unit": "queries/s",
+            "vs_baseline": round(qps / exact_qps, 3),
+            "platform": platform,
+            "recall": round(recall, 4),
+            "n_probes": n_probes,
+            "strategy": strategy,
+            "pallas": pallas_used,
+            "build_s": round(build_s, 1),
+            "exact_qps": round(exact_qps, 1),
+            "n": n,
+        }
     )
 
 
@@ -450,24 +469,22 @@ def run_serve_leg() -> None:
     svc.stop()
 
     st = svc.stats("bench")
-    print(
-        json.dumps(
-            {
-                "metric": f"serve_qps_ivf_flat_n{n // 1000}k_k{k}",
-                "value": round(n_requests / wall, 1),
-                "unit": "queries/s",
-                "platform": "cpu",
-                "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
-                "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
-                "batch_fill": round(st["batch_fill"], 3)
-                if st["batch_fill"] else None,
-                "batches": st["batches"],
-                "recompiles": st["recompiles"],
-                "warmup_compiles": st["warmup_compiles"],
-                "requests": n_requests,
-                "n": n,
-            }
-        )
+    _emit(
+        {
+            "metric": f"serve_qps_ivf_flat_n{n // 1000}k_k{k}",
+            "value": round(n_requests / wall, 1),
+            "unit": "queries/s",
+            "platform": "cpu",
+            "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+            "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+            "batch_fill": round(st["batch_fill"], 3)
+            if st["batch_fill"] else None,
+            "batches": st["batches"],
+            "recompiles": st["recompiles"],
+            "warmup_compiles": st["warmup_compiles"],
+            "requests": n_requests,
+            "n": n,
+        }
     )
 
 
@@ -532,34 +549,32 @@ def run_obs_leg() -> None:
     snap = svc.metrics()["registry"]
     svc.stop()
     compiles_by_span = snap["counters"].get("raft_tpu_xla_compiles_total", {})
-    print(
-        json.dumps(
-            {
-                "metric": f"obs_serve_qps_ivf_flat_n{n // 1000}k_k{k}",
-                "value": round(n_requests / wall, 1),
-                "unit": "queries/s",
-                "platform": "cpu",
-                "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
-                "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
-                "recompiles": st["recompiles"],
-                "stages_ms": {
-                    s: {q: round(v, 3) for q, v in p.items()}
-                    for s, p in st["stages"].items()
-                },
-                "xla_compiles_by_span": compiles_by_span,
-                "xla_cache": snap["counters"].get(
-                    "raft_tpu_xla_executable_cache_total", {}
-                ),
-                "span_histograms": sorted(
-                    key.split("=", 1)[1]
-                    for key in snap["histograms"].get(
-                        "raft_tpu_span_seconds", {}
-                    )
-                ),
-                "slow_queries": len(snap["slow_queries"]["recent"]),
-                "requests": n_requests,
-            }
-        )
+    _emit(
+        {
+            "metric": f"obs_serve_qps_ivf_flat_n{n // 1000}k_k{k}",
+            "value": round(n_requests / wall, 1),
+            "unit": "queries/s",
+            "platform": "cpu",
+            "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+            "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+            "recompiles": st["recompiles"],
+            "stages_ms": {
+                s: {q: round(v, 3) for q, v in p.items()}
+                for s, p in st["stages"].items()
+            },
+            "xla_compiles_by_span": compiles_by_span,
+            "xla_cache": snap["counters"].get(
+                "raft_tpu_xla_executable_cache_total", {}
+            ),
+            "span_histograms": sorted(
+                key.split("=", 1)[1]
+                for key in snap["histograms"].get(
+                    "raft_tpu_span_seconds", {}
+                )
+            ),
+            "slow_queries": len(snap["slow_queries"]["recent"]),
+            "requests": n_requests,
+        }
     )
 
 

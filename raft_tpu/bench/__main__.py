@@ -103,6 +103,11 @@ def _clamp_n_lists(config, ds):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "compare":
+        # regression gate: diff two bench records, exit nonzero on a
+        # regression — ``python -m raft_tpu.bench compare --baseline X``
+        return export.compare_main(argv[1:])
     ap = argparse.ArgumentParser("raft_tpu.bench")
     ap.add_argument("--dataset", default="sift-128-euclidean")
     ap.add_argument("--scale", type=float, default=0.01,
@@ -206,6 +211,31 @@ def main(argv=None):
     base = os.path.join(args.out, f"{out_name}")
     runner.save_results(results, base + ".json")
     export.to_csv(results, base + ".csv")
+    # one comparable headline record per run: the best-QPS operating point
+    # among the runs that achieved the sweep's best recall — the shape
+    # ``compare`` diffs (schema-versioned, same envelope as bench.py legs)
+    try:
+        best_recall = max(r.recall for r in results)
+        head = max(
+            (r for r in results if r.recall >= best_recall - 0.02),
+            key=lambda r: r.qps,
+        )
+        export.write_bench_record(
+            {
+                "metric": f"bench_{out_name}_k{args.k}",
+                "value": round(head.qps, 1),
+                "unit": "queries/s",
+                "platform": "cpu" if os.environ.get(
+                    "RAFT_TPU_PLATFORM") == "cpu" else None,
+                "recall": round(head.recall, 4),
+                "latency_ms": round(head.latency_ms, 3),
+                "algo": head.algo,
+                "search_param": head.search_param,
+            },
+            base + "_record.json",
+        )
+    except Exception as e:  # record is an artifact, not the result
+        print(f"bench record not written: {e}", file=sys.stderr)
     try:
         plot.plot_results(results, base + ".png")
     except Exception as e:  # plotting is best-effort (headless variations)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from raft_tpu.bench import device_time
 from raft_tpu.core.resources import Resources, ensure
 from raft_tpu.bench.datasets import Dataset
-from raft_tpu.stats import neighborhood_recall
+from raft_tpu.stats import recall_at_k
 
 
 class ANN:
@@ -630,9 +630,7 @@ def run_case(
             v, i = algo.search(queries, k)
         jax.block_until_ready((v, i))
         dt = (time.perf_counter() - t0) / iters
-        rec = float(
-            neighborhood_recall(np.asarray(i), ds.gt_neighbors[:, :k])
-        )
+        rec = recall_at_k(np.asarray(i), ds.gt_neighbors[:, :k])
         # device-side time for one batch (None off-accelerator)
         dev_s = device_time.measure_device_time(
             lambda qq: algo.search(qq, k), queries

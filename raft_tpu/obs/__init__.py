@@ -21,6 +21,14 @@ into queryable state:
 - :mod:`~raft_tpu.obs.slowlog` — slow-query log with stage breakdowns.
 - :mod:`~raft_tpu.obs.profiler` — ``obs.profile(dir)``: one-line
   Perfetto capture.
+- :mod:`~raft_tpu.obs.quality` — online recall auditor: shadow-samples
+  served batches against an exact oracle off the hot path, with a
+  degradation alarm on the recall EWMA.
+- :mod:`~raft_tpu.obs.cost` — XLA capacity accounting: per-executable
+  FLOPs / bytes / peak memory from ``cost_analysis()`` plus roofline
+  utilization and live-buffer gauges per index version.
+- :mod:`~raft_tpu.obs.health` — OK/DEGRADED/UNHEALTHY verdicts behind
+  ``SearchService.healthz()`` / ``readyz()``.
 
 Quick start::
 
@@ -35,8 +43,16 @@ Quick start::
 See ``docs/observability.md`` for the guided tour.
 """
 
+from raft_tpu.obs.cost import (
+    CostReport,
+    analyze_callable,
+    analyze_compiled,
+    record_cost,
+    refresh_live_buffer_gauges,
+)
 from raft_tpu.obs.export import snapshot_json, to_prometheus, write_snapshot
 from raft_tpu.obs.profiler import profile
+from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -54,7 +70,7 @@ from raft_tpu.obs.spans import (
     span,
     spans_snapshot,
 )
-from raft_tpu.obs import slowlog, spans, xla_events
+from raft_tpu.obs import cost, health, quality, slowlog, spans, xla_events
 
 registry = default_registry  # `obs.registry()` reads as the obvious accessor
 
@@ -75,17 +91,26 @@ def snapshot():
 
 
 __all__ = [
+    "CostReport",
     "Counter",
     "Gauge",
     "Histogram",
     "LabelCardinalityError",
     "MetricsRegistry",
+    "QualityAuditor",
     "Span",
+    "analyze_callable",
+    "analyze_compiled",
+    "cost",
     "current_span",
     "default_registry",
+    "health",
     "install",
     "profile",
+    "quality",
     "recent_spans",
+    "record_cost",
+    "refresh_live_buffer_gauges",
     "registry",
     "set_enabled",
     "slowlog",

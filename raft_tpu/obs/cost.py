@@ -1,0 +1,290 @@
+"""XLA capacity accounting: what each compiled executable costs the chip.
+
+"Memory Safe Computations with XLA Compiler" (PAPERS.md) makes the case
+that memory/compute figures must come from the compiler, not from a
+guess: XLA already knows the FLOPs, the bytes each HLO touches, and the
+buffer sizes it allocated — this module surfaces those numbers as
+queryable gauges, per serving executable, so "are we near the roofline"
+and "did the old index version's arrays actually get freed" stop being
+profiler questions.
+
+Three layers:
+
+- :func:`analyze_compiled` — tolerant extraction from a ``jax`` AOT
+  ``Compiled`` object.  ``cost_analysis()`` returns a list of dicts on
+  some backends, a dict on others, and ``None`` (or raises) on the rest;
+  ``memory_analysis()`` may lack a peak-memory field entirely (the CPU
+  client derives nothing).  Whatever is absent stays absent — no gauge is
+  ever published from a made-up number.
+- :func:`analyze_callable` + :func:`record_cost` — AOT-compile a callable
+  at given arg shapes, time one execution of the already-compiled
+  executable, and publish ``raft_tpu_xla_*`` gauges with a roofline
+  utilization estimate against configurable device peaks
+  (``RAFT_TPU_PEAK_FLOPS`` / ``RAFT_TPU_PEAK_BW`` env vars, else
+  per-platform defaults).
+- :func:`refresh_live_buffer_gauges` — walks an
+  :class:`~raft_tpu.serve.registry.IndexRegistry`'s weakly-referenced
+  version history and publishes ``raft_tpu_index_live_bytes`` per
+  (name, version) still alive on the host; versions the GC has collected
+  get their series *removed*, so a stale series IS the leak report.
+
+Everything here runs at warmup or snapshot time — never on the serving
+hot path — and every extraction is exception-tolerant: a backend that
+cannot answer degrades to absent gauges, not to a crashed warmup.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from raft_tpu.core.logger import child as _child_logger
+from raft_tpu.obs.registry import MetricsRegistry, default_registry
+
+_log = _child_logger("obs.cost")
+
+#: (peak FLOP/s, peak memory bandwidth bytes/s) per platform family.
+#: TPU figures track a v5e-class part (bf16 matmul peak, HBM2e bw); the
+#: CPU default is a deliberately round server-class estimate.  Override
+#: with RAFT_TPU_PEAK_FLOPS / RAFT_TPU_PEAK_BW for the actual part.
+DEFAULT_PEAKS: Dict[str, Tuple[float, float]] = {
+    "tpu": (197e12, 819e9),
+    "gpu": (312e12, 2039e9),
+    "cpu": (1e11, 5e10),
+}
+
+
+def device_peaks(platform: Optional[str] = None) -> Tuple[float, float]:
+    """(peak_flops_per_s, peak_bytes_per_s) for the active platform."""
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:  # no backend at all — fall through to cpu row
+            platform = "cpu"
+    flops, bw = DEFAULT_PEAKS.get(platform, DEFAULT_PEAKS["cpu"])
+    flops = float(os.environ.get("RAFT_TPU_PEAK_FLOPS", flops))
+    bw = float(os.environ.get("RAFT_TPU_PEAK_BW", bw))
+    return flops, bw
+
+
+@dataclass
+class CostReport:
+    """Everything extractable from one compiled executable (None = the
+    backend would not say)."""
+
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    peak_memory_bytes: Optional[float] = None
+    argument_memory_bytes: Optional[float] = None
+    output_memory_bytes: Optional[float] = None
+    temp_memory_bytes: Optional[float] = None
+    generated_code_bytes: Optional[float] = None
+    seconds: Optional[float] = None          # one timed post-compile run
+    utilization: Optional[float] = None      # achieved / roofline-attainable
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {k: v for k, v in vars(self).items() if v is not None}
+
+
+def _cost_props(compiled) -> Dict[str, float]:
+    """Flatten cost_analysis() across its per-backend shapes."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if ca is None:
+        return {}
+    if isinstance(ca, dict):
+        ca = [ca]
+    out: Dict[str, float] = {}
+    try:
+        for entry in ca:
+            for key, val in dict(entry).items():
+                if isinstance(val, (int, float)):
+                    out[key] = out.get(key, 0.0) + float(val)
+    except Exception:
+        return {}
+    return out
+
+
+def analyze_compiled(compiled) -> CostReport:
+    """Extract a :class:`CostReport` from a jax AOT ``Compiled`` object.
+
+    Never raises: fields the backend cannot report stay ``None``.
+    """
+    rep = CostReport()
+    props = _cost_props(compiled)
+    if "flops" in props:
+        rep.flops = props["flops"]
+    if "bytes accessed" in props:
+        rep.bytes_accessed = props["bytes accessed"]
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        def _grab(*names):
+            for n in names:
+                v = getattr(mem, n, None)
+                if isinstance(v, (int, float)) and v >= 0:
+                    return float(v)
+            return None
+
+        rep.argument_memory_bytes = _grab("argument_size_in_bytes")
+        rep.output_memory_bytes = _grab("output_size_in_bytes")
+        rep.temp_memory_bytes = _grab("temp_size_in_bytes")
+        rep.generated_code_bytes = _grab("generated_code_size_in_bytes")
+        # TPU clients report peak directly; the CPU client doesn't — the
+        # arg+output+temp sum is the working-set lower bound XLA admits to
+        rep.peak_memory_bytes = _grab("peak_memory_in_bytes")
+        if rep.peak_memory_bytes is None:
+            parts = [
+                p for p in (
+                    rep.argument_memory_bytes,
+                    rep.output_memory_bytes,
+                    rep.temp_memory_bytes,
+                )
+                if p is not None
+            ]
+            if parts:
+                rep.peak_memory_bytes = float(sum(parts))
+    return rep
+
+
+def roofline_utilization(
+    flops: Optional[float],
+    bytes_accessed: Optional[float],
+    seconds: Optional[float],
+    platform: Optional[str] = None,
+) -> Optional[float]:
+    """Achieved FLOP/s as a fraction of the roofline-attainable rate.
+
+    Attainable = ``min(peak_flops, intensity * peak_bw)`` — the classic
+    roofline ceiling at the program's arithmetic intensity.  1.0 means
+    the executable runs as fast as this hardware can run *this* program;
+    low values point at launch overhead or a mis-scheduled kernel rather
+    than "needs a bigger chip".  None when any input is unknown.
+    """
+    if not flops or not seconds or seconds <= 0:
+        return None
+    peak_flops, peak_bw = device_peaks(platform)
+    attainable = peak_flops
+    if bytes_accessed and bytes_accessed > 0:
+        attainable = min(peak_flops, (flops / bytes_accessed) * peak_bw)
+    if attainable <= 0:
+        return None
+    return float((flops / seconds) / attainable)
+
+
+def analyze_callable(fn, *args, time_run: bool = True) -> Optional[CostReport]:
+    """AOT-compile ``fn`` at ``args``'s shapes and report its cost.
+
+    With ``time_run`` the *compiled* executable is executed once and
+    timed, yielding the roofline utilization estimate.  Returns ``None``
+    when lowering/compilation itself fails (e.g. a backend without AOT
+    support) — callers treat that as "no gauges", not an error.
+
+    Note the compile here is a real XLA compile: callers must only do
+    this at warmup (the serve stack does), never per request.
+    """
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+    except Exception as exc:
+        _log.debug("cost analysis unavailable: %r", exc)
+        return None
+    rep = analyze_compiled(compiled)
+    if time_run:
+        try:
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(*args))
+            rep.seconds = time.perf_counter() - t0
+        except Exception:
+            rep.seconds = None
+    rep.utilization = roofline_utilization(
+        rep.flops, rep.bytes_accessed, rep.seconds
+    )
+    return rep
+
+
+#: gauge name → CostReport attribute published by record_cost
+_GAUGES = (
+    ("raft_tpu_xla_flops", "flops",
+     "FLOPs per execution of a compiled serving executable"),
+    ("raft_tpu_xla_bytes_accessed", "bytes_accessed",
+     "bytes each execution moves (XLA cost model)"),
+    ("raft_tpu_peak_memory_bytes", "peak_memory_bytes",
+     "peak (or derived arg+out+temp) device memory of one executable"),
+    ("raft_tpu_xla_argument_memory_bytes", "argument_memory_bytes",
+     "argument buffer bytes of one executable"),
+    ("raft_tpu_xla_output_memory_bytes", "output_memory_bytes",
+     "output buffer bytes of one executable"),
+    ("raft_tpu_xla_roofline_utilization", "utilization",
+     "achieved FLOP/s over the roofline-attainable rate (0..1)"),
+)
+
+
+def record_cost(
+    report: Optional[CostReport],
+    registry: Optional[MetricsRegistry] = None,
+    **labels: str,
+) -> None:
+    """Publish a report's known fields as gauges; absent fields publish
+    nothing (the acceptance contract for backends that return None)."""
+    if report is None:
+        return
+    reg = registry if registry is not None else default_registry()
+    report.labels = {str(k): str(v) for k, v in labels.items()}
+    for gauge_name, attr, help_ in _GAUGES:
+        val = getattr(report, attr)
+        if val is not None:
+            reg.gauge(gauge_name, help=help_).set(float(val), **labels)
+
+
+# ---------------------------------------------------------------------------
+# live-buffer accounting per IndexRegistry version
+
+def refresh_live_buffer_gauges(
+    index_registry, registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, float]:
+    """Publish ``raft_tpu_index_live_bytes{index=,version=}`` for every
+    index version still alive on the host.
+
+    The serve :class:`~raft_tpu.serve.registry.IndexRegistry` keeps a
+    weak reference to every version it has ever held; a hot-swapped-out
+    version whose arrays are still reachable (an in-flight batch, a
+    caller's stray reference, a leak) keeps its gauge — a version the GC
+    collected gets its series removed.  The dashboard view is therefore
+    exact: two live series under one name during a swap is normal for
+    seconds, and a pathological leak is an old version's series that
+    never disappears.
+    """
+    reg = registry if registry is not None else default_registry()
+    gauge = reg.gauge(
+        "raft_tpu_index_live_bytes",
+        help="host+device bytes held by each still-reachable index version",
+    )
+    live: Dict[str, float] = {}
+    alive_keys = set()
+    for (name, version), index in index_registry.live_versions().items():
+        try:
+            nbytes = float(index.device_bytes())
+        except Exception:
+            continue
+        labels = {"index": name, "version": str(version)}
+        gauge.set(nbytes, **labels)
+        alive_keys.add((name, str(version)))
+        live[f"{name}:v{version}"] = nbytes
+    # retire series whose version object is gone
+    for key in gauge.series():
+        d = dict(key)
+        if "index" in d and "version" in d:
+            if (d["index"], d["version"]) not in alive_keys:
+                gauge.remove(**d)
+    return live

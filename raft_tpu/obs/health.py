@@ -1,0 +1,181 @@
+"""Aggregated health verdicts: one answer to "should this replica serve?".
+
+The registry holds dozens of series; a load balancer needs three states.
+This module folds the signals the serve stack already produces — warmup
+state, hot-path recompiles, queue depth, audited recall, device memory
+headroom — into per-index and overall ``OK`` / ``DEGRADED`` /
+``UNHEALTHY`` verdicts, published as the ``raft_tpu_health`` gauge
+(0/1/2) and returned as one JSON-safe report from
+``SearchService.healthz()``.
+
+Verdict semantics follow the k8s probe convention the names suggest:
+``readyz`` (traffic gate) fails while warming or UNHEALTHY; ``healthz``
+(liveness/diagnostics) always answers, carrying the per-check detail so
+the *reason* for a red verdict is in the same payload as the verdict.
+
+The thresholds are deliberately simple and documented constants — the
+point is an actionable default, not a tunable anomaly detector:
+
+- any hot-path recompile after warmup is DEGRADED; ``COMPILE_STORM`` of
+  them is UNHEALTHY (the latency path is paying seconds-long compiles);
+- queue depth beyond ``QUEUE_DEGRADED_FACTOR``×max_batch is DEGRADED
+  (coalescing has fallen behind arrivals), beyond
+  ``QUEUE_UNHEALTHY_FACTOR``× is UNHEALTHY;
+- audited recall EWMA below the auditor's threshold is DEGRADED, below
+  half of it UNHEALTHY;
+- device memory above ``MEM_DEGRADED_FRAC`` of the limit is DEGRADED,
+  above ``MEM_UNHEALTHY_FRAC`` UNHEALTHY (backends without
+  ``memory_stats`` report the check as unknown → OK).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from raft_tpu.obs.registry import MetricsRegistry, default_registry
+
+OK = "OK"
+DEGRADED = "DEGRADED"
+UNHEALTHY = "UNHEALTHY"
+
+#: gauge encoding (and severity order) of the verdicts
+VERDICT_VALUES = {OK: 0, DEGRADED: 1, UNHEALTHY: 2}
+
+COMPILE_STORM = 5            # hot-path recompiles → UNHEALTHY at this many
+QUEUE_DEGRADED_FACTOR = 4    # queue depth in units of max_batch
+QUEUE_UNHEALTHY_FACTOR = 16
+MEM_DEGRADED_FRAC = 0.90
+MEM_UNHEALTHY_FRAC = 0.98
+
+
+def worst(*verdicts: str) -> str:
+    return max(verdicts, key=lambda v: VERDICT_VALUES[v], default=OK)
+
+
+@dataclass
+class IndexProbe:
+    """Raw signals for one served index, gathered by the service."""
+
+    warm: bool
+    recompiles: int
+    queue_depth: int
+    max_batch: int
+    recall_ewma: Optional[float] = None     # None: auditor off / no audits yet
+    recall_threshold: Optional[float] = None
+
+
+def _check(status: str, detail: str) -> Dict[str, str]:
+    return {"status": status, "detail": detail}
+
+
+def index_health(probe: IndexProbe) -> Dict[str, object]:
+    """Fold one index's probe into {"status", "checks": {...}}."""
+    checks: Dict[str, Dict[str, str]] = {}
+
+    checks["warmup"] = (
+        _check(OK, "bucket ladder compiled")
+        if probe.warm
+        else _check(DEGRADED, "warmup not run; first queries will compile")
+    )
+
+    if probe.recompiles >= COMPILE_STORM:
+        checks["compiles"] = _check(
+            UNHEALTHY,
+            f"{probe.recompiles} hot-path recompiles (compile storm)",
+        )
+    elif probe.recompiles > 0:
+        checks["compiles"] = _check(
+            DEGRADED, f"{probe.recompiles} hot-path recompiles after warmup"
+        )
+    else:
+        checks["compiles"] = _check(OK, "0 recompiles after warmup")
+
+    depth, cap = probe.queue_depth, max(probe.max_batch, 1)
+    if depth > QUEUE_UNHEALTHY_FACTOR * cap:
+        checks["queue"] = _check(
+            UNHEALTHY, f"queue depth {depth} >> max_batch {cap}"
+        )
+    elif depth > QUEUE_DEGRADED_FACTOR * cap:
+        checks["queue"] = _check(
+            DEGRADED, f"queue depth {depth} > {QUEUE_DEGRADED_FACTOR}x max_batch"
+        )
+    else:
+        checks["queue"] = _check(OK, f"queue depth {depth}")
+
+    if probe.recall_ewma is None or probe.recall_threshold is None:
+        checks["recall"] = _check(OK, "no audited recall yet")
+    elif probe.recall_ewma < probe.recall_threshold * 0.5:
+        checks["recall"] = _check(
+            UNHEALTHY,
+            f"recall ewma {probe.recall_ewma:.3f} < half of threshold "
+            f"{probe.recall_threshold:.3f}",
+        )
+    elif probe.recall_ewma < probe.recall_threshold:
+        checks["recall"] = _check(
+            DEGRADED,
+            f"recall ewma {probe.recall_ewma:.3f} < threshold "
+            f"{probe.recall_threshold:.3f}",
+        )
+    else:
+        checks["recall"] = _check(
+            OK, f"recall ewma {probe.recall_ewma:.3f}"
+        )
+
+    status = worst(*(c["status"] for c in checks.values()))
+    return {"status": status, "checks": checks}
+
+
+def device_memory_check() -> Dict[str, str]:
+    """Headroom on device 0; unknown (OK) when the backend won't say."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return _check(OK, "memory stats unavailable on this backend")
+    used = stats.get("bytes_in_use")
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    if not used or not limit:
+        return _check(OK, "memory stats incomplete on this backend")
+    frac = used / limit
+    detail = f"{used / 2**20:.0f}MiB / {limit / 2**20:.0f}MiB ({frac:.0%})"
+    if frac > MEM_UNHEALTHY_FRAC:
+        return _check(UNHEALTHY, "device memory exhausted: " + detail)
+    if frac > MEM_DEGRADED_FRAC:
+        return _check(DEGRADED, "device memory pressure: " + detail)
+    return _check(OK, detail)
+
+
+def build_report(
+    probes: Dict[str, IndexProbe],
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, object]:
+    """Assemble the service-wide report and publish ``raft_tpu_health``.
+
+    One gauge series per index plus ``index=overall`` — the overall
+    verdict also folds in the device memory check, which is a property of
+    the process, not of any one index.
+    """
+    reg = registry if registry is not None else default_registry()
+    gauge = reg.gauge(
+        "raft_tpu_health",
+        help="serving health verdict (0=OK, 1=DEGRADED, 2=UNHEALTHY)",
+    )
+    indexes: Dict[str, object] = {}
+    statuses = []
+    for name, probe in probes.items():
+        rep = index_health(probe)
+        indexes[name] = rep
+        statuses.append(rep["status"])
+        gauge.set(VERDICT_VALUES[rep["status"]], index=name)
+    mem = device_memory_check()
+    overall = worst(mem["status"], *statuses)
+    gauge.set(VERDICT_VALUES[overall], index="overall")
+    return {
+        "status": overall,
+        "memory": mem,
+        "indexes": indexes,
+    }

@@ -88,6 +88,26 @@ class _Metric:
         with self._lock:
             return list(self._series.keys())
 
+    def remove(self, **labels: str) -> bool:
+        """Drop one labeled series; True when it existed.
+
+        Retiring a label set (an unregistered index, a dead registry
+        version) must also retire its series, or the exporter keeps
+        publishing the last value forever — a gauge that can never go
+        away reads as a leak that never resolves."""
+        with self._lock:
+            return self._series.pop(_label_key(labels), None) is not None
+
+    def remove_matching(self, **labels: str) -> int:
+        """Drop every series whose labels include ``labels``; returns the
+        count removed (``index=x`` clears all of x's versions at once)."""
+        want = set(_label_key(labels))
+        with self._lock:
+            dead = [k for k in self._series if want.issubset(set(k))]
+            for k in dead:
+                del self._series[k]
+            return len(dead)
+
 
 class Counter(_Metric):
     """Monotonically increasing count (requests, compiles, errors)."""

@@ -20,6 +20,7 @@ queue wait is included — that is the number a caller actually experiences.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -34,6 +35,11 @@ from raft_tpu.serve.metrics import ServingMetrics, compile_count
 
 # search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k])
 SearchFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
+
+# observer: (queries [n, dim], distances [n, k], ids [n, k]) -> None, called
+# with the REAL (unpadded) rows after each dispatched batch resolves.  Must
+# be non-blocking — the quality auditor's sample-and-enqueue qualifies.
+Observer = Callable[[np.ndarray, np.ndarray, np.ndarray], None]
 
 
 def _next_pow2(n: int) -> int:
@@ -72,6 +78,18 @@ class MicroBatcher:
     start:
         When True (default) the worker thread starts immediately.  Tests
         use ``start=False`` + :meth:`flush` for deterministic batching.
+    observer:
+        Optional post-dispatch hook receiving the real rows of every
+        resolved batch ``(queries, distances, ids)`` — the quality
+        auditor's shadow-sampling entry.  Exceptions are swallowed and
+        the call sits after future resolution, so a misbehaving observer
+        can delay the *next* batch but never fail or block a result.
+    cost_accounting:
+        When True (default; env ``RAFT_TPU_COST_ACCOUNTING=0`` disables)
+        :meth:`warmup` additionally AOT-compiles each bucket's executable
+        for XLA cost/memory analysis and publishes ``raft_tpu_xla_*``
+        gauges.  Purely best-effort: backends that cannot answer leave
+        the gauges absent.
     """
 
     def __init__(
@@ -84,6 +102,8 @@ class MicroBatcher:
         max_delay_ms: float = 2.0,
         metrics: Optional[ServingMetrics] = None,
         start: bool = True,
+        observer: Optional[Observer] = None,
+        cost_accounting: Optional[bool] = None,
     ):
         if dim <= 0:
             raise ValueError(f"dim must be positive, got {dim}")
@@ -101,6 +121,12 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_delay_s = float(max_delay_ms) * 1e-3
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.observer = observer
+        if cost_accounting is None:
+            cost_accounting = os.environ.get(
+                "RAFT_TPU_COST_ACCOUNTING", "1"
+            ) != "0"
+        self.cost_accounting = bool(cost_accounting)
 
         self._cond = threading.Condition()
         self._queue: List[_Request] = []
@@ -134,6 +160,13 @@ class MicroBatcher:
         blocks on the result.  Compiles spent here are booked as
         ``warmup_compiles`` and the hot-path recompile counter is reset, so
         any later non-zero ``recompiles`` is a genuine shape leak.
+
+        With ``cost_accounting`` each bucket's executable is additionally
+        AOT-compiled for :mod:`raft_tpu.obs.cost` analysis — FLOPs, bytes
+        accessed, peak memory and roofline utilization land as
+        ``raft_tpu_xla_*`` gauges labeled ``index=<name>,bucket=<b>``.
+        The extra compiles happen here, inside warmup, so the hot-path
+        zero-recompile contract is untouched.
         """
         total = 0
         with self._dispatch_lock, trace_range("serve.warmup"):
@@ -143,10 +176,38 @@ class MicroBatcher:
                 dist, ids = self._search_fn(jax.numpy.asarray(dummy))
                 jax.block_until_ready((dist, ids))
                 total += compile_count() - c0
+                if self.cost_accounting:
+                    self._account_bucket_cost(b, dummy)
         self.metrics.record_warmup(total)
         self.metrics.reset_hot_path()
         self._warm = True
         return total
+
+    def _account_bucket_cost(self, bucket: int, dummy: np.ndarray) -> None:
+        """Best-effort XLA cost/memory gauges for one bucket's executable."""
+        try:
+            from raft_tpu.obs import cost as obs_cost
+
+            report = obs_cost.analyze_callable(
+                self._search_fn, jax.numpy.asarray(dummy)
+            )
+            obs_cost.record_cost(
+                report,
+                index=self.metrics.name or "default",
+                bucket=str(bucket),
+            )
+        except Exception:  # noqa: BLE001 — accounting must not fail warmup
+            pass
+
+    @property
+    def warm(self) -> bool:
+        """True once :meth:`warmup` has compiled the bucket ladder."""
+        return self._warm
+
+    def queue_depth(self) -> int:
+        """Rows currently waiting for dispatch (health signal)."""
+        with self._cond:
+            return sum(r.rows.shape[0] for r in self._queue)
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -329,6 +390,15 @@ class MicroBatcher:
             req.future.set_result((dist[off : off + m], ids[off : off + m]))
             off += m
             lats.append(done - req.t_submit)
+        observer = self.observer
+        if observer is not None:
+            # futures are already resolved; the observer (quality auditor)
+            # sees only the real rows and must itself be non-blocking
+            try:
+                observer(padded[:n], dist[:n], ids[:n])
+            except Exception:  # noqa: BLE001 — auditing never fails serving
+                pass
+        self.metrics.record_queue_depth(self.queue_depth())
         self.metrics.record_batch(
             n, bucket, lats, compiles,
             stages={

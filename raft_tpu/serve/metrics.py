@@ -104,6 +104,7 @@ class ServingMetrics:
         self.warmup_compiles = 0   # compiles spent in explicit warmup
         self._fill_real = 0        # sum of real rows over all batches
         self._fill_padded = 0      # sum of padded bucket rows
+        self._queue_depth = 0      # rows queued at the last dispatch
         if name is not None:
             obs.default_registry().register_provider(
                 f"serve.{name}", self.snapshot
@@ -183,6 +184,17 @@ class ServingMetrics:
                 for v in vals:
                     st_h.observe(v, stage=s, **label)
 
+    def record_queue_depth(self, depth: int) -> None:
+        """Rows still queued at dispatch time — the health/backpressure
+        signal.  Mirrored as a gauge for named instances."""
+        with self._lock:
+            self._queue_depth = int(depth)
+        if self.name is not None:
+            obs.default_registry().gauge(
+                "raft_tpu_serve_queue_depth",
+                help="rows waiting for dispatch at the last batch boundary",
+            ).set(depth, index=self.name)
+
     def record_warmup(self, compiles: int) -> None:
         with self._lock:
             self.warmup_compiles += compiles
@@ -207,6 +219,7 @@ class ServingMetrics:
                 "batches": self.batches,
                 "recompiles": self.recompiles,
                 "warmup_compiles": self.warmup_compiles,
+                "queue_depth": self._queue_depth,
                 "batch_fill": (
                     self._fill_real / self._fill_padded
                     if self._fill_padded

@@ -41,7 +41,7 @@ import numpy as np
 
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core import serialize as ser
-from raft_tpu.core.trace import trace_range
+from raft_tpu.core.trace import trace_range, traced
 from raft_tpu.distance import DISTANCE_TYPES
 from raft_tpu.ops.matrix import select_k
 
@@ -151,6 +151,30 @@ class MutableIndex:
         with self._lock:
             return self._generation
 
+    def device_bytes(self) -> int:
+        """Bytes held by this index's arrays (main structure + serve
+        state).  Feeds the per-version live-buffer gauges
+        (:func:`raft_tpu.obs.cost.refresh_live_buffer_gauges`): the number
+        an operator compares across versions to spot a swapped-out index
+        whose arrays never freed."""
+
+        def _nb(x) -> int:
+            nb = getattr(x, "nbytes", None)
+            return int(nb) if isinstance(nb, (int, np.integer)) else 0
+
+        total = sum(_nb(v) for v in vars(self.index).values())
+        with self._lock:
+            total += _nb(self._side_data) + _nb(self._side_ids)
+            total += _nb(self._side_live) + _nb(self._deleted)
+            snap = self._snapshot_cache
+        if snap is not None:
+            for arr in (snap.side_data, snap.side_ids):
+                total += _nb(arr)
+            for bs in (snap.tombstones, snap.side_live):
+                if bs is not None:
+                    total += _nb(bs.words)
+        return total
+
     def contains(self, id_: int) -> bool:
         with self._lock:
             if 0 <= id_ < self.main_size and not self._deleted[id_]:
@@ -159,6 +183,7 @@ class MutableIndex:
             return bool(hits.any())
 
     # -- mutation ------------------------------------------------------------
+    @traced("serve.upsert")
     def upsert(self, vectors, ids=None) -> np.ndarray:
         """Insert (or replace) vectors; returns their global ids.
 
@@ -193,6 +218,7 @@ class MutableIndex:
             self._bump_locked()
         return ids
 
+    @traced("serve.delete")
     def delete(self, ids) -> int:
         """Tombstone ids (main or side); returns how many were live."""
         ids = np.atleast_1d(np.asarray(ids, dtype=np.int64))

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 from raft_tpu.core import serialize as ser
@@ -33,6 +34,13 @@ class IndexRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: Dict[str, Tuple[MutableIndex, int]] = {}
+        # weak history of every version ever bound: the live-buffer
+        # accounting (obs.cost.refresh_live_buffer_gauges) walks this to
+        # tell "swapped out and freed" from "swapped out and leaked" —
+        # weak refs so the history itself never pins an old version
+        self._history: "weakref.WeakValueDictionary[Tuple[str, int], MutableIndex]" = (
+            weakref.WeakValueDictionary()
+        )
 
     # -- registration / swap -------------------------------------------------
     def register(
@@ -56,6 +64,7 @@ class IndexRegistry:
             # tuple replacement is a single reference store — atomic for
             # readers holding no lock
             self._entries[name] = (index, version)
+            self._history[(name, version)] = index
             return version
 
     def swap(self, name: str, index: MutableIndex) -> int:
@@ -65,6 +74,7 @@ class IndexRegistry:
                 raise KeyError(f"no index named {name!r} to swap")
             version = self._entries[name][1] + 1
             self._entries[name] = (index, version)
+            self._history[(name, version)] = index
             return version
 
     def unregister(self, name: str) -> None:
@@ -88,6 +98,13 @@ class IndexRegistry:
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._entries)
+
+    def live_versions(self) -> Dict[Tuple[str, int], MutableIndex]:
+        """Every (name, version) whose index object is still reachable —
+        current versions plus any swapped-out version something still
+        pins (an in-flight batch, or a leak)."""
+        with self._lock:
+            return dict(self._history)
 
     def __contains__(self, name: str) -> bool:
         with self._lock:

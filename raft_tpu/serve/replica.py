@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.comms.comms import Comms, local_comms
 from raft_tpu.core.compat import shard_map
 from raft_tpu.core.trace import trace_range
@@ -158,6 +159,14 @@ class ReplicaGroup:
                 self.comms, lambda q_shard, kk: index.search(q_shard, kk)
             )
             self._searchers[name] = cached = (key, run)
+            # every rebuild retraces the replicated executables on next
+            # dispatch — a counter climbing on the hot path is the
+            # "swap/mutation churn is eating compiles" capacity signal
+            obs.default_registry().counter(
+                "raft_tpu_replica_searcher_builds_total",
+                help="replicated searcher (re)builds, one per index "
+                "version/generation change",
+            ).inc(index=name)
         return cached[1](queries, k)
 
     def searcher(self, name: str, k: int):

@@ -34,6 +34,10 @@ import threading
 from typing import Dict, Optional
 
 from raft_tpu import obs
+from raft_tpu.core.trace import traced
+from raft_tpu.obs import cost as obs_cost
+from raft_tpu.obs import health as obs_health
+from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
@@ -54,6 +58,8 @@ class SearchService:
         max_delay_ms: float = 2.0,
         replicas: Optional[ReplicaGroup] = None,
         start: bool = True,
+        auditor: Optional[QualityAuditor] = None,
+        cost_accounting: Optional[bool] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -66,6 +72,8 @@ class SearchService:
         self.max_batch = max_batch
         self.max_delay_ms = max_delay_ms
         self.replicas = replicas
+        self.auditor = auditor
+        self.cost_accounting = cost_accounting
         self._start = start
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -95,6 +103,8 @@ class SearchService:
                 max_delay_ms=self.max_delay_ms,
                 metrics=ServingMetrics(name=name),
                 start=self._start,
+                observer=self._make_observer(name),
+                cost_accounting=self.cost_accounting,
             )
             self._batchers[name] = batcher
         if old is not None:
@@ -114,6 +124,35 @@ class SearchService:
 
         return search_fn
 
+    def _make_observer(self, name: str):
+        """Batcher observer feeding the quality auditor, if any.
+
+        Reads ``self.auditor`` per call so :meth:`attach_auditor` takes
+        effect on already-running batchers.  The (index, version) pair is
+        resolved here, right after the dispatch — a swap racing between
+        the dispatch and the observation can attribute one audited batch
+        to the successor version, which the auditor's per-version EWMA
+        reset absorbs.
+        """
+
+        def observer(queries, dists, ids):
+            auditor = self.auditor
+            if auditor is None:
+                return
+            index, version = self.registry.get_versioned(name)
+            auditor.observe(name, version, index, queries, ids)
+
+        return observer
+
+    def attach_auditor(self, auditor: Optional[QualityAuditor]) -> None:
+        """Install (or remove, with ``None``) the online recall auditor.
+
+        Existing batchers pick it up immediately — their observer closures
+        read ``self.auditor`` at call time.
+        """
+        self.auditor = auditor
+
+    @traced("serve.swap")
     def swap(self, name: str, index) -> int:
         """Atomically replace the index behind ``name`` (see module doc).
 
@@ -154,10 +193,12 @@ class SearchService:
         """Async search; returns a Future of (distances, ids)."""
         return self._batcher(name).submit(queries)
 
+    @traced("serve.search")
     def search(self, name: str, queries, timeout: Optional[float] = None):
         """Sync search through the batcher (coalesces with live traffic)."""
         return self._batcher(name).search(queries, timeout=timeout)
 
+    @traced("serve.warmup")
     def warmup(self, name: Optional[str] = None) -> int:
         """Compile the bucket ladder(s); returns total compiles spent."""
         names = [name] if name is not None else self.names()
@@ -188,6 +229,59 @@ class SearchService:
         )
         return out
 
+    def _refresh_capacity_gauges(self) -> None:
+        """Re-derive the per-version live-buffer gauges from the registry's
+        weak version history.  Gauges are pull-refreshed (not provider-fed)
+        because ``to_prometheus()`` does not run providers — every export
+        path below calls this first."""
+        try:
+            obs_cost.refresh_live_buffer_gauges(self.registry)
+        except Exception:  # capacity accounting must never break serving
+            pass
+
+    def healthz(self) -> Dict[str, object]:
+        """Aggregated health verdict: OK / DEGRADED / UNHEALTHY.
+
+        One :class:`raft_tpu.obs.health.IndexProbe` per served name —
+        warmup state, hot-path recompiles, queue depth vs capacity, and
+        the auditor's recall EWMA when an auditor is attached — folded
+        with the device-memory headroom check by
+        :func:`raft_tpu.obs.health.build_report`.  Also publishes the
+        ``raft_tpu_health`` gauge (0=OK, 1=DEGRADED, 2=UNHEALTHY) so the
+        verdict is scrapeable.
+        """
+        self._refresh_capacity_gauges()
+        auditor = self.auditor
+        probes: Dict[str, obs_health.IndexProbe] = {}
+        for name in self.names():
+            try:
+                b = self._batcher(name)
+            except KeyError:  # removed between names() and here
+                continue
+            probes[name] = obs_health.IndexProbe(
+                warm=b.warm,
+                recompiles=b.metrics.recompiles,
+                queue_depth=b.queue_depth(),
+                max_batch=b.max_batch,
+                recall_ewma=(
+                    auditor.recall_ewma(name) if auditor is not None else None
+                ),
+                recall_threshold=(
+                    auditor.threshold if auditor is not None else None
+                ),
+            )
+        return obs_health.build_report(probes, registry=obs.default_registry())
+
+    def readyz(self) -> Dict[str, object]:
+        """Readiness: every served index warmed (bucket ladder compiled).
+
+        Unlike :meth:`healthz` this is a gate, not a diagnosis — a load
+        balancer should withhold traffic until ``ready`` is true, then
+        switch to ``healthz`` for liveness.
+        """
+        warm = {n: self._batcher(n).warm for n in self.names()}
+        return {"ready": bool(warm) and all(warm.values()), "indexes": warm}
+
     def metrics(self) -> Dict[str, object]:
         """The whole observability picture in one JSON-safe dict.
 
@@ -195,15 +289,26 @@ class SearchService:
         + per-stage breakdown); ``registry`` is the process-wide
         :func:`raft_tpu.obs.snapshot` — span histograms, XLA compile events
         attributed to the span that caused them, cache hit/miss counts,
-        the slow-query log, and each index's ``serve.<name>`` section.
+        the slow-query log, and each index's ``serve.<name>`` section;
+        ``health`` is the :meth:`healthz` report.
         """
         return {
             "indexes": {n: self.stats(n) for n in self.names()},
+            "health": self.healthz(),
             "registry": obs.snapshot(),
         }
 
     def prometheus(self) -> str:
-        """The process metrics registry in Prometheus text format."""
+        """The process metrics registry in Prometheus text format.
+
+        Refreshes the pull-style gauges first (live-buffer bytes per index
+        version, ``raft_tpu_health``) — the exporter itself never runs
+        providers, so the refresh has to happen on the scrape path.
+        """
+        try:
+            self.healthz()  # publishes raft_tpu_health + capacity gauges
+        except Exception:
+            pass
         return obs.to_prometheus()
 
     # -- lifecycle -----------------------------------------------------------

@@ -12,6 +12,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.distance.pairwise import distance_matrix_tile
 
@@ -40,11 +41,102 @@ def regression_metrics(pred: jax.Array, ref: jax.Array) -> Dict[str, jax.Array]:
     }
 
 
-def neighborhood_recall(indices: jax.Array, ref_indices: jax.Array) -> jax.Array:
-    """Fraction of reference neighbors recovered, per the reference's ANN
+def recall_at_k(indices, ref_indices, k: Optional[int] = None) -> float:
+    """Canonical host-side recall@k — THE recall every consumer shares.
+
+    Order-insensitive set-intersection recall, the reference's ANN
     evaluation metric (ref: stats/neighborhood_recall.cuh;
-    cpp/test/neighbors/ann_utils.cuh:128 calc_recall — set-intersection per
-    row / (rows * k), order-insensitive)."""
+    cpp/test/neighbors/ann_utils.cuh:128 calc_recall): the fraction of
+    reference neighbors recovered anywhere in the served top-k.  Negative
+    reference ids (padding / pruned slots) are excluded from the
+    denominator.  Pure numpy on purpose: the obs quality auditor calls
+    this from a background thread while serving traffic, where a stray
+    jnp dispatch would (a) race the serve recompile attribution bracket
+    and (b) contend for the device.  ``k`` truncates both sides (default:
+    the smaller of the two widths).
+    """
+    ids = np.asarray(indices)
+    ref = np.asarray(ref_indices)
+    if ids.ndim != 2 or ref.ndim != 2 or ids.shape[0] != ref.shape[0]:
+        raise ValueError(
+            f"expected [rows, k] id matrices, got {ids.shape} vs {ref.shape}"
+        )
+    if k is None:
+        k = min(ids.shape[1], ref.shape[1])
+    ids = ids[:, :k]
+    ref = ref[:, :k]
+    valid = ref >= 0
+    if not valid.any():
+        return 0.0
+    match = (ids[:, :, None] == ref[:, None, :]).any(axis=1)
+    return float((match & valid).sum() / valid.sum())
+
+
+def tie_aware_recall_at_k(
+    distances, ref_distances, k: Optional[int] = None,
+    *, eps: float = 1e-4, select_min: bool = True,
+) -> float:
+    """Distance-based recall that forgives ties at the k-th boundary.
+
+    An index returning a different-but-equidistant neighbor is not wrong;
+    id-set recall (:func:`recall_at_k`) still penalizes it.  This variant
+    counts a served neighbor as correct when its distance is within a
+    relative ``eps`` of the row's k-th reference distance (ann-benchmarks'
+    epsilon-recall).  ``select_min=False`` flips the comparison for
+    similarity metrics (inner product) where larger is better.
+    """
+    d = np.asarray(distances, dtype=np.float64)
+    rd = np.asarray(ref_distances, dtype=np.float64)
+    if d.ndim != 2 or rd.ndim != 2 or d.shape[0] != rd.shape[0]:
+        raise ValueError(
+            f"expected [rows, k] distance matrices, got {d.shape} vs {rd.shape}"
+        )
+    if k is None:
+        k = min(d.shape[1], rd.shape[1])
+    d = d[:, :k]
+    thresh = rd[:, k - 1 : k]  # row-wise k-th best reference distance
+    tol = eps * np.maximum(np.abs(thresh), 1.0)
+    ok = d <= thresh + tol if select_min else d >= thresh - tol
+    return float(ok.mean())
+
+
+def rank_displacement(indices, ref_indices, k: Optional[int] = None) -> float:
+    """Mean |served rank − true rank| of the reference neighbors.
+
+    Recall sees *whether* a true neighbor appears; displacement sees
+    *where* — an index that always ranks the true nearest neighbor 9th
+    holds recall@10 = 1.0 while this metric reads ~8.  A reference
+    neighbor missing from the served list costs ``k`` (the worst possible
+    displacement), so the value degrades smoothly into recall loss.
+    Negative reference ids are excluded.  0.0 is perfect.
+    """
+    ids = np.asarray(indices)
+    ref = np.asarray(ref_indices)
+    if ids.ndim != 2 or ref.ndim != 2 or ids.shape[0] != ref.shape[0]:
+        raise ValueError(
+            f"expected [rows, k] id matrices, got {ids.shape} vs {ref.shape}"
+        )
+    if k is None:
+        k = min(ids.shape[1], ref.shape[1])
+    ids = ids[:, :k]
+    ref = ref[:, :k]
+    eq = ids[:, :, None] == ref[:, None, :]        # [rows, k_served, k_ref]
+    pos = np.argmax(eq, axis=1)                    # first match (0 if none)
+    found = eq.any(axis=1)
+    ideal = np.arange(k)[None, :]
+    disp = np.where(found, np.abs(pos - ideal), k)
+    valid = ref >= 0
+    if not valid.any():
+        return 0.0
+    return float(disp[valid].mean())
+
+
+def neighborhood_recall(indices: jax.Array, ref_indices: jax.Array) -> jax.Array:
+    """Device-side (jit-capable) variant of :func:`recall_at_k`.
+
+    Same set-intersection semantics; stays jnp so it can run inside a
+    traced computation.  Host-side consumers (bench, the quality auditor)
+    use :func:`recall_at_k` directly."""
     indices = jnp.asarray(indices)
     ref_indices = jnp.asarray(ref_indices)
     match = (indices[:, :, None] == ref_indices[:, None, :]).any(axis=1)

@@ -8,6 +8,11 @@ backend module, carries the ``__traced__`` marker that
 ``raft_tpu.core.trace.traced`` stamps on its wrappers.  This is what keeps
 the obs story zero-churn — spans exist because the decorator is there, so
 this test is the enforcement end of the tentpole.
+
+The serve surface is covered explicitly (methods, not module functions):
+the online entry points — ``SearchService.search/swap/warmup``,
+``MutableIndex.upsert/delete`` — must report spans too, with unique
+labels, or a serving latency excursion has no span to decompose into.
 """
 
 import inspect
@@ -16,6 +21,7 @@ import pytest
 
 import raft_tpu.cluster
 import raft_tpu.neighbors
+import raft_tpu.serve
 
 #: canonical entry-point names inside exported backend modules.  A helper
 #: named anything else is free to stay untraced; anything on this list is
@@ -83,6 +89,49 @@ def test_every_entry_point_is_traced():
         "entry points without @traced (add the decorator so the obs "
         f"registry sees them): {missing}"
     )
+
+
+#: online (method) entry points and the span label each must carry —
+#: additions to the serve API surface belong on this list
+SERVE_ENTRY_POINTS = {
+    "SearchService.search": "serve.search",
+    "SearchService.swap": "serve.swap",
+    "SearchService.warmup": "serve.warmup",
+    "MutableIndex.upsert": "serve.upsert",
+    "MutableIndex.delete": "serve.delete",
+}
+
+
+def _serve_methods():
+    for dotted, label in SERVE_ENTRY_POINTS.items():
+        cls_name, meth_name = dotted.split(".")
+        cls = getattr(raft_tpu.serve, cls_name)
+        yield dotted, getattr(cls, meth_name), label
+
+
+def test_serve_entry_points_are_traced():
+    missing = sorted(
+        dotted
+        for dotted, fn, _ in _serve_methods()
+        if not getattr(fn, "__traced__", None)
+    )
+    assert not missing, (
+        "serve entry points without @traced (online latency excursions "
+        f"would have no span to decompose): {missing}"
+    )
+
+
+def test_serve_traced_labels_match_and_are_unique():
+    seen = {}
+    for dotted, fn, expected in _serve_methods():
+        label = getattr(fn, "__traced__", None)
+        assert label == expected, (
+            f"{dotted} carries span label {label!r}, expected {expected!r}"
+        )
+        assert label not in seen, (
+            f"span label {label!r} reused by {seen[label]} and {dotted}"
+        )
+        seen[label] = dotted
 
 
 @pytest.mark.parametrize("pkg", PACKAGES, ids=lambda p: p.__name__)
