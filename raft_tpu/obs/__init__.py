@@ -65,6 +65,8 @@ from raft_tpu.obs.slowlog import slowlog_snapshot
 from raft_tpu.obs.spans import (
     Span,
     current_span,
+    finish_span,
+    open_span,
     recent_spans,
     set_enabled,
     span,
@@ -104,8 +106,10 @@ __all__ = [
     "cost",
     "current_span",
     "default_registry",
+    "finish_span",
     "health",
     "install",
+    "open_span",
     "profile",
     "quality",
     "recent_spans",

@@ -264,6 +264,14 @@ def refresh_live_buffer_gauges(
     exact: two live series under one name during a swap is normal for
     seconds, and a pathological leak is an old version's series that
     never disappears.
+
+    Pipelined dispatch interacts here by design: at ``pipeline_depth``
+    > 1 up to that many batches can each pin the version they resolved,
+    so a swapped-out version legitimately stays live for up to
+    ``pipeline_depth`` batch completions (bounded by the in-flight
+    semaphore) rather than one.  The gauges stay truthful because they
+    report reachability, not intent — the leak signal is a series that
+    outlives the window, not one that exists during it.
     """
     reg = registry if registry is not None else default_registry()
     gauge = reg.gauge(

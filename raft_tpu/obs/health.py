@@ -61,6 +61,8 @@ class IndexProbe:
     recompiles: int
     queue_depth: int
     max_batch: int
+    pipeline_depth: int = 1                 # in-flight window bound (1=serial)
+    inflight: int = 0                       # device batches currently in flight
     recall_ewma: Optional[float] = None     # None: auditor off / no audits yet
     recall_threshold: Optional[float] = None
 
@@ -102,6 +104,21 @@ def index_health(probe: IndexProbe) -> Dict[str, object]:
         )
     else:
         checks["queue"] = _check(OK, f"queue depth {depth}")
+
+    # the pipeline's one invariant: in-flight batches never exceed the
+    # configured window.  An overrun means the semaphore bound broke —
+    # live device memory is no longer bounded — which is a bug, not load.
+    if probe.inflight > probe.pipeline_depth:
+        checks["pipeline"] = _check(
+            UNHEALTHY,
+            f"{probe.inflight} batches in flight > pipeline_depth "
+            f"{probe.pipeline_depth} (window invariant broken)",
+        )
+    else:
+        checks["pipeline"] = _check(
+            OK,
+            f"in-flight {probe.inflight} / depth {probe.pipeline_depth}",
+        )
 
     if probe.recall_ewma is None or probe.recall_threshold is None:
         checks["recall"] = _check(OK, "no audited recall yet")

@@ -155,6 +155,33 @@ def _record_finished(sp: Span, parent: Optional[Span]) -> None:
             _recent.append(sp)
 
 
+def open_span(name: str) -> Optional[Span]:
+    """A *detached* root span for operations that cross threads.
+
+    The pipelined serve dispatch opens a ``serve.batch`` span on the
+    dispatch thread and closes it on the completion thread — a lifetime
+    no context manager on either thread can express.  Detached spans are
+    never pushed on a thread-local stack, so :func:`current_span` does
+    not see them and XLA events attribute to whatever stacked span is
+    open instead (after warmup the pipelined hot path emits no events,
+    so nothing is lost).  Returns ``None`` when obs is disabled; close
+    with :func:`finish_span`.
+    """
+    if _disabled:
+        return None
+    return Span(name, next(_ids), None)
+
+
+def finish_span(sp: Optional[Span]) -> None:
+    """Close a span from :func:`open_span`: stamps the end time, feeds
+    ``raft_tpu_span_seconds`` and the recent-roots ring.  Idempotent and
+    None-tolerant so error paths can call it unconditionally."""
+    if sp is None or sp.t_end is not None:
+        return
+    sp.t_end = time.perf_counter()
+    _record_finished(sp, None)
+
+
 def recent_spans(n: int = 50) -> List[Dict[str, object]]:
     """Most recent finished root spans, newest last (JSON-safe)."""
     with _recent_lock:

@@ -16,21 +16,44 @@ it wakes; if the pending rows are below ``max_batch`` it waits up to
 ``max_delay_ms`` (measured from the oldest queued request) for stragglers,
 then dispatches.  Latency recorded per request is submit→complete, i.e.
 queue wait is included — that is the number a caller actually experiences.
+
+Pipelined dispatch (``pipeline_depth`` > 1): the dispatch path splits
+into three stages so the host and device overlap instead of taking
+turns.  (1) The worker pads the batch into a reusable per-bucket staging
+buffer and *enqueues* the warmed executable without blocking on the
+result; (2) a semaphore bounds the in-flight window to ``pipeline_depth``
+device batches, so live device memory stays bounded and the host stalls
+(``inflight_wait`` stage) instead of overrunning the device; (3) a
+completion thread blocks on the *oldest* in-flight batch, copies results
+out, resolves futures in submission order, and runs the observer /
+metrics / slow-log off the dispatch path.  ``pipeline_depth=1`` keeps
+the original fully-serial dispatch, byte for byte.  Steady-state QPS at
+depth > 1 is bounded by the *max* of the host and device stage times
+rather than their sum (``bench.py serve`` measures the A/B).
+
+Staging-buffer safety: completion is strictly FIFO and the semaphore
+caps in-flight batches at ``pipeline_depth``, so by the time a bucket's
+ring slot (one of ``pipeline_depth`` per bucket) comes around again its
+previous occupant has fully completed — including the observer call,
+which sees a *copy* of the staged rows precisely because the auditor
+holds samples past the batch's lifetime.
 """
 
 from __future__ import annotations
 
 import os
+import queue as queue_mod
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from raft_tpu.core.trace import trace_range
-from raft_tpu.obs import slowlog
+from raft_tpu.obs import slowlog, spans
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
 
 # search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k])
@@ -53,6 +76,21 @@ class _Request:
         self.rows = rows
         self.future = future
         self.t_submit = t_submit
+
+
+class _InFlight:
+    """One dispatched-but-not-completed batch, handed from the dispatch
+    thread to the completion thread in submission order."""
+
+    __slots__ = (
+        "batch", "padded", "n", "bucket", "queue_waits", "t_pad",
+        "inflight_wait", "t_dispatch", "t_enqueued", "dist", "ids",
+        "compiles", "sp", "done",
+    )
+
+    def __init__(self, batch: List[_Request]):
+        self.batch = batch
+        self.done = threading.Event()
 
 
 class MicroBatcher:
@@ -90,6 +128,16 @@ class MicroBatcher:
         for XLA cost/memory analysis and publishes ``raft_tpu_xla_*``
         gauges.  Purely best-effort: backends that cannot answer leave
         the gauges absent.
+    pipeline_depth:
+        Bound on device batches in flight (default from
+        ``RAFT_TPU_PIPELINE_DEPTH``, else 2).  ``1`` reproduces the
+        original serial dispatch exactly: pad, enqueue, block, resolve —
+        all on the dispatching thread.  At depth > 1 the host pads and
+        enqueues the next batch while up to ``pipeline_depth`` earlier
+        batches run on the device; a completion thread resolves futures
+        in submission order.  Memory cost: ``pipeline_depth`` staging
+        buffers per touched bucket plus the live device buffers of the
+        in-flight batches.
     """
 
     def __init__(
@@ -104,6 +152,7 @@ class MicroBatcher:
         start: bool = True,
         observer: Optional[Observer] = None,
         cost_accounting: Optional[bool] = None,
+        pipeline_depth: Optional[int] = None,
     ):
         if dim <= 0:
             raise ValueError(f"dim must be positive, got {dim}")
@@ -127,14 +176,41 @@ class MicroBatcher:
                 "RAFT_TPU_COST_ACCOUNTING", "1"
             ) != "0"
         self.cost_accounting = bool(cost_accounting)
+        if pipeline_depth is None:
+            pipeline_depth = int(
+                os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2")
+            )
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
+        self.pipeline_depth = int(pipeline_depth)
 
         self._cond = threading.Condition()
-        self._queue: List[_Request] = []
+        self._queue: Deque[_Request] = deque()
         self._stopping = False
-        # one dispatch at a time, shared by worker thread and flush()
+        # one dispatch *stage* at a time, shared by worker thread and
+        # flush(); at depth 1 it additionally covers the device wait (the
+        # original serial behavior)
         self._dispatch_lock = threading.Lock()
         self._warm = False
         self._thread: Optional[threading.Thread] = None
+        # -- pipelined dispatch state (idle at pipeline_depth == 1) ----------
+        self._inflight_sem = threading.Semaphore(self.pipeline_depth)
+        self._inflight_q: "queue_mod.Queue[Optional[_InFlight]]" = (
+            queue_mod.Queue()
+        )
+        self._completion_thread: Optional[threading.Thread] = None
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+        # per-bucket ring of pipeline_depth reusable staging buffers
+        self._staging: Dict[int, List[Optional[np.ndarray]]] = {}
+        self._staging_idx: Dict[int, int] = {}
+        # union of [enqueue, ready] intervals: device-busy estimate for the
+        # bench's idle-fraction figure (completion thread only)
+        self._busy_s = 0.0
+        self._busy_until = 0.0
+        self.metrics.record_pipeline(self.pipeline_depth, 0)
         if start:
             self.start()
 
@@ -220,7 +296,10 @@ class MicroBatcher:
 
     def stop(self, drain: bool = True) -> None:
         """Stop the worker thread; with ``drain`` pending requests complete
-        first, otherwise they fail with :class:`RuntimeError`."""
+        first, otherwise they fail with :class:`RuntimeError`.  Batches
+        already in flight complete and resolve their futures either way —
+        they were dispatched before the stop, and dropping device results
+        on the floor would break the delivered-exactly-once contract."""
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
@@ -231,12 +310,22 @@ class MicroBatcher:
             self.flush()
         else:
             with self._cond:
-                pending, self._queue = self._queue, []
+                pending, self._queue = self._queue, deque()
             for req in pending:
                 req.future.set_exception(
                     RuntimeError("MicroBatcher stopped before dispatch")
                 )
+        self._shutdown_completion()
         self.metrics.close()
+
+    def _shutdown_completion(self) -> None:
+        """Drain the completion thread: in-flight batches finish, then the
+        sentinel stops the loop.  Safe to call with nothing in flight."""
+        t = self._completion_thread
+        if t is not None and t.is_alive():
+            self._inflight_q.put(None)
+            t.join()
+        self._completion_thread = None
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -293,15 +382,34 @@ class MicroBatcher:
 
     # -- batching core -------------------------------------------------------
     def flush(self) -> int:
-        """Dispatch everything queued right now; returns batches issued."""
+        """Dispatch everything queued right now; returns batches issued.
+
+        Routes through the same path traffic takes: serial dispatch in
+        this thread at depth 1, the pipeline at depth > 1 — so a flush
+        racing in-flight batches cannot reorder result delivery (the
+        completion thread resolves strictly in submission order) and
+        never holds ``_dispatch_lock`` across a device wait it did not
+        pay for.  Returns only after every batch it dispatched has
+        resolved its futures and recorded its metrics."""
         n_batches = 0
+        last: Optional[_InFlight] = None
         while True:
             with self._cond:
                 if not self._queue:
-                    return n_batches
+                    break
                 batch = self._take_batch_locked()
-            self._dispatch(batch)
+            if self.pipeline_depth == 1:
+                self._dispatch(batch)
+            else:
+                rec = self._dispatch_pipelined(batch)
+                if rec is not None:
+                    last = rec
             n_batches += 1
+        if last is not None:
+            # FIFO completion: the last record's done event implies every
+            # earlier one dispatched here has fully completed too
+            last.done.wait()
+        return n_batches
 
     def _take_batch_locked(self) -> List[_Request]:
         """Pop a prefix of the queue totalling at most max_batch rows."""
@@ -310,7 +418,7 @@ class MicroBatcher:
             nxt = self._queue[0]
             if taken and rows + nxt.rows.shape[0] > self.max_batch:
                 break
-            taken.append(self._queue.pop(0))
+            taken.append(self._queue.popleft())
             rows += nxt.rows.shape[0]
         return taken
 
@@ -337,8 +445,11 @@ class MicroBatcher:
                 if not self._queue:
                     continue
                 batch = self._take_batch_locked()
-            with self._dispatch_lock:
-                self._dispatch_locked(batch)
+            if self.pipeline_depth > 1:
+                self._dispatch_pipelined(batch)
+            else:
+                with self._dispatch_lock:
+                    self._dispatch_locked(batch)
 
     def _dispatch(self, batch: List[_Request]) -> None:
         with self._dispatch_lock:
@@ -417,6 +528,207 @@ class MicroBatcher:
                     "requests": len(batch),
                     "bucket": bucket,
                     "compiles": compiles,
+                },
+            )
+
+    # -- pipelined dispatch (pipeline_depth > 1) -----------------------------
+    @property
+    def inflight(self) -> int:
+        """Device batches dispatched but not yet completed."""
+        with self._inflight_lock:
+            return self._inflight
+
+    def device_busy_s(self) -> float:
+        """Seconds the device had at least one batch outstanding.
+
+        Pipelined path: exact union of the [enqueue, ready] intervals
+        (FIFO completion keeps the incremental union O(1)).  Serial path:
+        the sum of recorded device-stage durations, which is the same
+        quantity because nothing overlaps at depth 1.  Benches derive the
+        device-idle fraction as ``1 - device_busy_s / wall``."""
+        if self.pipeline_depth > 1:
+            with self._inflight_lock:
+                return self._busy_s
+        return self.metrics.stage_totals().get("device", 0.0)
+
+    def _staging_buffer(self, bucket: int) -> np.ndarray:
+        """Next slot of the bucket's staging ring (dispatch lock held).
+
+        Safe to reuse without copying: at most ``pipeline_depth`` batches
+        are ever in flight and completion is FIFO, so a slot's previous
+        occupant — ``pipeline_depth`` same-bucket dispatches ago, hence at
+        least ``pipeline_depth`` global dispatches ago — has fully
+        completed (semaphore released only after copy-out and observer)
+        before the slot comes around again."""
+        ring = self._staging.get(bucket)
+        if ring is None:
+            ring = self._staging[bucket] = [None] * self.pipeline_depth
+            self._staging_idx[bucket] = 0
+        i = self._staging_idx[bucket]
+        self._staging_idx[bucket] = (i + 1) % self.pipeline_depth
+        buf = ring[i]
+        if buf is None:
+            buf = ring[i] = np.empty((bucket, self.dim), dtype=np.float32)
+        return buf
+
+    def _ensure_completion_thread(self) -> None:
+        # only called under _dispatch_lock, so no start/start race
+        t = self._completion_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(
+            target=self._completer, name="raft-tpu-serve-completer",
+            daemon=True,
+        )
+        self._completion_thread = t
+        t.start()
+
+    def _dispatch_pipelined(self, batch: List[_Request]
+                            ) -> Optional[_InFlight]:
+        """Stage 1+2: pad into a staging buffer, enqueue device work, hand
+        the record to the completion thread.  Never blocks on the device;
+        blocks only on the in-flight window (``inflight_wait``).  Returns
+        the in-flight record, or None for an empty batch or a dispatch-
+        stage failure (which fails only this batch's futures)."""
+        if not batch:
+            return None
+        t_arrive = time.perf_counter()
+        # acquire the window slot BEFORE the dispatch lock: a full window
+        # must stall this dispatcher without also blocking the completion
+        # thread's progress (it never takes either)
+        self._inflight_sem.acquire()
+        t_acquired = time.perf_counter()
+        with self._dispatch_lock:
+            rec = _InFlight(batch)
+            rec.inflight_wait = t_acquired - t_arrive
+            # queue-wait ends when the batch is picked up for dispatch
+            rec.queue_waits = [t_acquired - r.t_submit for r in batch]
+            n = sum(r.rows.shape[0] for r in batch)
+            bucket = self.bucket_for(n)
+            t0 = time.perf_counter()
+            padded = self._staging_buffer(bucket)
+            off = 0
+            for req in batch:
+                m = req.rows.shape[0]
+                padded[off : off + m] = req.rows
+                off += m
+            if off < bucket:
+                # zero the tail so depth>1 results stay bit-identical to
+                # the serial path's freshly-zeroed pad
+                padded[off:] = 0.0
+            rec.n, rec.bucket, rec.padded = n, bucket, padded
+            rec.t_pad = time.perf_counter() - t0
+            # detached span: opened here, closed by the completion thread
+            rec.sp = spans.open_span("serve.batch")
+            try:
+                c0 = compile_count()
+                t1 = time.perf_counter()
+                dist, ids = self._search_fn(jax.numpy.asarray(padded))
+                t2 = time.perf_counter()
+                rec.t_dispatch = t2 - t1
+                # compiles happen synchronously at trace/enqueue time, so
+                # the bracket closes here, not after the device wait
+                rec.compiles = compile_count() - c0
+                rec.dist, rec.ids = dist, ids
+            except Exception as exc:  # noqa: BLE001 — fail only this batch
+                spans.finish_span(rec.sp)
+                self._inflight_sem.release()
+                for req in batch:
+                    req.future.set_exception(exc)
+                return None
+            rec.t_enqueued = time.perf_counter()
+            self._ensure_completion_thread()
+            with self._inflight_lock:
+                self._inflight += 1
+                inflight = self._inflight
+            self.metrics.record_pipeline(self.pipeline_depth, inflight)
+            self._inflight_q.put(rec)
+        return rec
+
+    def _completer(self) -> None:
+        """Stage 3: block on the oldest in-flight batch, copy out, resolve
+        futures in submission order, run observer/metrics/slow-log."""
+        while True:
+            rec = self._inflight_q.get()
+            if rec is None:
+                return
+            try:
+                self._complete(rec)
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    inflight = self._inflight
+                # release AFTER _complete: the staging slot must not be
+                # reusable until copy-out and the observer are done with it
+                self._inflight_sem.release()
+                self.metrics.record_pipeline(self.pipeline_depth, inflight)
+                rec.done.set()
+
+    def _complete(self, rec: _InFlight) -> None:
+        batch = rec.batch
+        t3 = time.perf_counter()
+        try:
+            jax.block_until_ready((rec.dist, rec.ids))
+            t4 = time.perf_counter()
+            dist = np.asarray(rec.dist)
+            ids = np.asarray(rec.ids)
+        except Exception as exc:  # noqa: BLE001 — fail only this batch
+            spans.finish_span(rec.sp)
+            for req in batch:
+                req.future.set_exception(exc)
+            return
+        t_device = t4 - t3
+        # device-busy union for the idle-fraction estimate: FIFO completion
+        # means intervals arrive ordered by start time
+        with self._inflight_lock:
+            if t4 > self._busy_until:
+                self._busy_s += t4 - max(rec.t_enqueued, self._busy_until)
+                self._busy_until = t4
+        if rec.sp is not None:
+            rec.sp.add_stage("queue", max(rec.queue_waits, default=0.0))
+            rec.sp.add_stage("pad", rec.t_pad)
+            rec.sp.add_stage("inflight_wait", rec.inflight_wait)
+            rec.sp.add_stage("dispatch", rec.t_dispatch)
+            rec.sp.add_stage("device", t_device)
+        spans.finish_span(rec.sp)
+        done = time.perf_counter()
+        off = 0
+        lats = []
+        for req in batch:
+            m = req.rows.shape[0]
+            req.future.set_result((dist[off : off + m], ids[off : off + m]))
+            off += m
+            lats.append(done - req.t_submit)
+        observer = self.observer
+        if observer is not None:
+            # the staging slot outlives this call only until the semaphore
+            # releases, but the auditor holds samples longer — hand it a
+            # copy of the real rows (dist/ids are fresh arrays already)
+            try:
+                observer(rec.padded[: rec.n].copy(), dist[: rec.n],
+                         ids[: rec.n])
+            except Exception:  # noqa: BLE001 — auditing never fails serving
+                pass
+        self.metrics.record_queue_depth(self.queue_depth())
+        self.metrics.record_batch(
+            rec.n, rec.bucket, lats, rec.compiles,
+            stages={
+                "queue": rec.queue_waits,
+                "pad": (rec.t_pad,),
+                "inflight_wait": (rec.inflight_wait,),
+                "dispatch": (rec.t_dispatch,),
+                "device": (t_device,),
+            },
+        )
+        if rec.sp is not None:
+            slowlog.maybe_record(
+                rec.sp,
+                latency_s=max(lats, default=0.0),
+                detail={
+                    "index": self.metrics.name,
+                    "requests": len(batch),
+                    "bucket": rec.bucket,
+                    "compiles": rec.compiles,
                 },
             )
 

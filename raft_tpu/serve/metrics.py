@@ -35,8 +35,11 @@ from raft_tpu import obs
 
 _RESERVOIR = 4096
 
-#: stage names the batcher reports, in display order
-STAGES = ("queue", "pad", "dispatch", "device")
+#: stage names the batcher reports, in display order.  ``inflight_wait``
+#: only appears at pipeline_depth > 1: it is the time a formed batch
+#: waited for an in-flight window slot (device backpressure), measured
+#: before the dispatch stage.
+STAGES = ("queue", "pad", "inflight_wait", "dispatch", "device")
 
 # ---- process-wide XLA compile counter -------------------------------------
 
@@ -105,6 +108,9 @@ class ServingMetrics:
         self._fill_real = 0        # sum of real rows over all batches
         self._fill_padded = 0      # sum of padded bucket rows
         self._queue_depth = 0      # rows queued at the last dispatch
+        self._pipeline_depth = 1   # in-flight window size (1 = serial)
+        self._inflight = 0         # device batches currently in flight
+        self._inflight_peak = 0    # high-water mark of the above
         if name is not None:
             obs.default_registry().register_provider(
                 f"serve.{name}", self.snapshot
@@ -195,6 +201,28 @@ class ServingMetrics:
                 help="rows waiting for dispatch at the last batch boundary",
             ).set(depth, index=self.name)
 
+    def record_pipeline(self, depth: int, inflight: int) -> None:
+        """Pipeline window state: ``depth`` is the configured bound,
+        ``inflight`` the batches currently dispatched but not completed.
+        The peak is retained so a concurrency test (or an operator) can
+        assert the in-flight window was never overrun.  Mirrored as
+        ``raft_tpu_serve_pipeline_depth`` / ``raft_tpu_serve_inflight_batches``
+        gauges for named instances."""
+        with self._lock:
+            self._pipeline_depth = int(depth)
+            self._inflight = int(inflight)
+            self._inflight_peak = max(self._inflight_peak, int(inflight))
+        if self.name is not None:
+            reg = obs.default_registry()
+            reg.gauge(
+                "raft_tpu_serve_pipeline_depth",
+                help="configured in-flight window bound (1 = serial dispatch)",
+            ).set(depth, index=self.name)
+            reg.gauge(
+                "raft_tpu_serve_inflight_batches",
+                help="device batches dispatched but not yet completed",
+            ).set(inflight, index=self.name)
+
     def record_warmup(self, compiles: int) -> None:
         with self._lock:
             self.warmup_compiles += compiles
@@ -220,6 +248,9 @@ class ServingMetrics:
                 "recompiles": self.recompiles,
                 "warmup_compiles": self.warmup_compiles,
                 "queue_depth": self._queue_depth,
+                "pipeline_depth": self._pipeline_depth,
+                "inflight": self._inflight,
+                "inflight_peak": self._inflight_peak,
                 "batch_fill": (
                     self._fill_real / self._fill_padded
                     if self._fill_padded
@@ -243,6 +274,19 @@ class ServingMetrics:
             if a.size
         }
         return out
+
+    def stage_totals(self) -> Dict[str, float]:
+        """Sum of each stage reservoir in seconds.
+
+        Input to the bench's device-idle-fraction estimate: the ``device``
+        total approximates how long the device had work outstanding.
+        Approximate once a reservoir wraps (bounded at construction), so
+        benches must keep their batch count under the reservoir size for
+        the number to be exact."""
+        with self._lock:
+            return {
+                s: float(sum(dq)) for s, dq in self._stage_lat.items() if dq
+            }
 
 
 def timed_percentiles(latencies_s, qs=(50, 99)) -> Optional[Dict[str, float]]:

@@ -13,7 +13,13 @@ per-query results.
 This composes with the rest of the serve stack: ``ReplicaGroup`` wraps an
 :class:`~raft_tpu.serve.registry.IndexRegistry`, so hot-swap and
 mutations behave exactly as in the single-chip path (the snapshot a
-search closes over is replicated at trace time).
+search closes over is replicated at trace time).  It also composes with
+pipelined dispatch: the returned searcher *enqueues* the replicated
+executable and returns unmaterialized device arrays — the batcher's
+completion thread is the only place that blocks — so at
+``pipeline_depth`` > 1 the host shards/pads the next batch while the
+mesh still computes the previous ones, with the same bounded in-flight
+window as the single-chip path.
 
 Shape discipline: query shards are ``bucket/size`` rows, so warming the
 bucket ladder warms the replicated executables too — one compile per

@@ -60,6 +60,7 @@ class SearchService:
         start: bool = True,
         auditor: Optional[QualityAuditor] = None,
         cost_accounting: Optional[bool] = None,
+        pipeline_depth: Optional[int] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -74,6 +75,9 @@ class SearchService:
         self.replicas = replicas
         self.auditor = auditor
         self.cost_accounting = cost_accounting
+        # None defers to the batcher's RAFT_TPU_PIPELINE_DEPTH / default;
+        # 1 forces the serial dispatch path for every served index
+        self.pipeline_depth = pipeline_depth
         self._start = start
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -105,6 +109,7 @@ class SearchService:
                 start=self._start,
                 observer=self._make_observer(name),
                 cost_accounting=self.cost_accounting,
+                pipeline_depth=self.pipeline_depth,
             )
             self._batchers[name] = batcher
         if old is not None:
@@ -204,7 +209,13 @@ class SearchService:
         names = [name] if name is not None else self.names()
         return sum(self._batcher(n).warmup() for n in names)
 
+    @traced("serve.flush")
     def flush(self, name: Optional[str] = None) -> int:
+        """Dispatch everything queued for ``name`` (or all indexes).
+
+        Routed through each batcher's pipeline: returns only after the
+        flushed batches have resolved their futures, and a flush racing
+        in-flight traffic cannot reorder result delivery."""
         names = [name] if name is not None else self.names()
         return sum(self._batcher(n).flush() for n in names)
 
@@ -243,8 +254,11 @@ class SearchService:
         """Aggregated health verdict: OK / DEGRADED / UNHEALTHY.
 
         One :class:`raft_tpu.obs.health.IndexProbe` per served name —
-        warmup state, hot-path recompiles, queue depth vs capacity, and
-        the auditor's recall EWMA when an auditor is attached — folded
+        warmup state, hot-path recompiles, queue depth vs capacity, the
+        pipeline's in-flight window occupancy vs its ``pipeline_depth``
+        bound (also scrapeable as ``raft_tpu_serve_pipeline_depth`` /
+        ``raft_tpu_serve_inflight_batches``), and the auditor's recall
+        EWMA when an auditor is attached — folded
         with the device-memory headroom check by
         :func:`raft_tpu.obs.health.build_report`.  Also publishes the
         ``raft_tpu_health`` gauge (0=OK, 1=DEGRADED, 2=UNHEALTHY) so the
@@ -263,6 +277,8 @@ class SearchService:
                 recompiles=b.metrics.recompiles,
                 queue_depth=b.queue_depth(),
                 max_batch=b.max_batch,
+                pipeline_depth=b.pipeline_depth,
+                inflight=b.inflight,
                 recall_ewma=(
                     auditor.recall_ewma(name) if auditor is not None else None
                 ),

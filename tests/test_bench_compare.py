@@ -225,3 +225,44 @@ def test_compare_against_frozen_cpu_baseline_smoke():
     assert out.returncode in (0, 1), out.stdout + out.stderr
     assert "PASS" in out.stdout or "FAIL" in out.stdout \
         or "SKIP" in out.stdout, out.stdout
+
+
+@pytest.mark.slow
+def test_serve_pipeline_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the pipelined-dispatch A/B: run ``bench.py serve`` at
+    depths 1,2 and gate it with ``bench.py compare`` against the frozen
+    serve-pipeline record.  The run itself must show the pipeline win
+    (depth=2 QPS strictly above depth=1, recompiles 0 at every depth) and
+    the compare must not trip the recompile or latency thresholds."""
+    candidate = str(tmp_path / "serve_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_PIPELINE_DEPTHS="1,2",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "serve"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    depths = line["depths"]
+    assert line["qps_vs_depth1"] > 1.0, (
+        f"pipeline showed no win: {line['qps_vs_depth1']}"
+    )
+    assert depths["2"]["qps"] > depths["1"]["qps"]
+    assert depths["2"]["p99_ms"] <= 1.2 * depths["1"]["p99_ms"]
+    for d, row in depths.items():
+        assert row["recompiles"] == 0, f"depth {d} recompiled on the hot path"
+    assert depths["2"]["inflight_peak"] <= 2
+
+    baseline = os.path.join(
+        REPO, "benchmarks", "BENCH_serve_pipeline_r06.json"
+    )
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

@@ -97,6 +97,7 @@ SERVE_ENTRY_POINTS = {
     "SearchService.search": "serve.search",
     "SearchService.swap": "serve.swap",
     "SearchService.warmup": "serve.warmup",
+    "SearchService.flush": "serve.flush",
     "MutableIndex.upsert": "serve.upsert",
     "MutableIndex.delete": "serve.delete",
 }
@@ -118,6 +119,28 @@ def test_serve_entry_points_are_traced():
     assert not missing, (
         "serve entry points without @traced (online latency excursions "
         f"would have no span to decompose): {missing}"
+    )
+
+
+def test_pipelined_dispatch_reports_detached_spans():
+    """The pipelined dispatch path cannot use ``@traced``/``trace_range``
+    (its ``serve.batch`` span opens on the dispatch thread and closes on
+    the completion thread, and thread-local span stacks don't cross), so
+    enforce the detached-span calls by source inspection: opened at
+    dispatch, finished on the completion path AND on both failure paths —
+    a dropped span would leak one unfinished record per failed batch."""
+    from raft_tpu.serve.batcher import MicroBatcher
+
+    dispatch_src = inspect.getsource(MicroBatcher._dispatch_pipelined)
+    complete_src = inspect.getsource(MicroBatcher._complete)
+    assert "open_span" in dispatch_src, (
+        "_dispatch_pipelined no longer opens the detached serve.batch span"
+    )
+    assert "finish_span" in dispatch_src, (
+        "_dispatch_pipelined's failure path must close the span it opened"
+    )
+    assert "finish_span" in complete_src, (
+        "_complete must close the detached span (success and failure)"
     )
 
 
