@@ -170,6 +170,9 @@ def main() -> None:
     if "obs" in sys.argv[1:]:
         run_obs_leg()
         return
+    if "flight" in sys.argv[1:]:
+        run_flight_leg()
+        return
     if probe_tpu() is not None:
         # verify cache serialization in a subprocess first — an unverified/
         # broken cache must never hang the bench
@@ -575,6 +578,149 @@ def run_serve_leg() -> None:
             "batch_fill": head["batch_fill"],
             "recompiles": sum(d["recompiles"] for d in by_depth.values()),
             "warmup_compiles": head["warmup_compiles"],
+            "requests": n_requests,
+            "n": n,
+        }
+    )
+
+
+def run_flight_leg() -> None:
+    """``python bench.py flight`` — flight-recorder overhead A/B (CPU).
+
+    Same paced-device serve workload as ``run_serve_leg`` (real host
+    stages, result readiness modeled as a serial device queue at
+    ``RAFT_TPU_BENCH_DEVICE_MS`` per batch), run twice at pipeline depth
+    2: once with observability fully disabled (``obs.set_enabled(False)``
+    — the runtime form of ``RAFT_TPU_OBS_DISABLED``, which no-ops spans,
+    exemplars and the flight recorder's ring appends) and once with the
+    always-on recorder recording every batch.  The headline value is the
+    recorder-on QPS; ``qps_ratio`` (on/off) is the cost of "always-on" —
+    the acceptance bar is within 3% on quiet hardware, and the frozen
+    record in ``benchmarks/`` gates regressions via ``bench.py compare``.
+    """
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu import obs
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import flight, slowlog
+    from raft_tpu.serve.batcher import MicroBatcher
+    from raft_tpu.serve.metrics import ServingMetrics
+
+    n, d, k = 8192, 64, 10
+    n_requests, n_clients, depth = 2048, 4, 2
+    device_ms = float(os.environ.get("RAFT_TPU_BENCH_DEVICE_MS", "10"))
+    slowlog.configure(None)  # open-loop flood: queue waits are the workload
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_requests, d), dtype=np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), dataset)
+    params = ivf_flat.SearchParams(n_probes=8)
+
+    class _Paced:
+        __slots__ = ("arr", "deadline")
+
+        def __init__(self, arr, deadline: float):
+            self.arr = arr
+            self.deadline = deadline
+
+        def block_until_ready(self):
+            jax.block_until_ready(self.arr)
+            rest = self.deadline - time.perf_counter()
+            if rest > 0:
+                time.sleep(rest)  # releases the GIL, like a TPU RPC
+            return self
+
+        def __array__(self, dtype=None):
+            a = np.asarray(self.arr)
+            return a if dtype is None else a.astype(dtype)
+
+    def make_paced_search():
+        lock = threading.Lock()
+        state = {"free": 0.0}
+
+        def search_fn(batch):
+            dist, ids = ivf_flat.search(params, index, batch, k)
+            with lock:
+                start = max(time.perf_counter(), state["free"])
+                state["free"] = deadline = start + device_ms * 1e-3
+            return _Paced(dist, deadline), _Paced(ids, deadline)
+
+        return search_fn
+
+    def run_arm(name: str) -> dict:
+        flight.reset()
+        batcher = MicroBatcher(
+            make_paced_search(), d, max_batch=32, max_delay_ms=0.5,
+            metrics=ServingMetrics(name=f"bench_flight_{name}"),
+            pipeline_depth=depth,
+        )
+        batcher.warmup()
+
+        def client(cid: int):
+            futs = [
+                batcher.submit(queries[i])
+                for i in range(cid, n_requests, n_clients)
+            ]
+            for f in futs:
+                f.result(timeout=300)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        st = batcher.metrics.snapshot()
+        recorded = flight.default_recorder().snapshot()["recorded_total"]
+        batcher.stop()
+        return {
+            "qps": round(n_requests / wall, 1),
+            "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+            "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+            "batches": st["batches"],
+            "recompiles": st["recompiles"],
+            "recorded_batches": recorded,
+        }
+
+    run_arm("warm")  # discarded: one-time jit/thread warmth must not bias
+    obs.set_enabled(False)
+    try:
+        off = run_arm("off")
+    finally:
+        obs.set_enabled(True)
+    on = run_arm("on")
+    assert on["recorded_batches"] >= on["batches"], (
+        "recorder-on arm recorded fewer batches than it dispatched"
+    )
+    assert off["recorded_batches"] == 0, (
+        "recorder-off arm still recorded batches"
+    )
+    ratio = round(on["qps"] / off["qps"], 4) if off["qps"] else None
+    _emit(
+        {
+            "metric": f"serve_flight_recorder_qps_ivf_flat_n{n // 1000}k_k{k}",
+            "value": on["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "device_ms": device_ms,
+            "pipeline_depth": depth,
+            "recorder_on": on,
+            "recorder_off": off,
+            "qps_ratio": ratio,
+            "overhead_pct": (
+                round((1.0 - ratio) * 100.0, 2) if ratio else None
+            ),
+            "recompiles": on["recompiles"] + off["recompiles"],
             "requests": n_requests,
             "n": n,
         }

@@ -29,6 +29,10 @@ into queryable state:
   utilization and live-buffer gauges per index version.
 - :mod:`~raft_tpu.obs.health` — OK/DEGRADED/UNHEALTHY verdicts behind
   ``SearchService.healthz()`` / ``readyz()``.
+- :mod:`~raft_tpu.obs.flight` — always-on flight recorder: a bounded
+  ring of recent batches with member request ids and per-request
+  timelines, auto-dumped (JSON + Perfetto-loadable Chrome trace) on
+  health/quality/recompile/exception incidents.
 
 Quick start::
 
@@ -50,7 +54,18 @@ from raft_tpu.obs.cost import (
     record_cost,
     refresh_live_buffer_gauges,
 )
-from raft_tpu.obs.export import snapshot_json, to_prometheus, write_snapshot
+from raft_tpu.obs.export import (
+    snapshot_json,
+    to_openmetrics,
+    to_prometheus,
+    write_snapshot,
+)
+from raft_tpu.obs.flight import (
+    FlightRecorder,
+    default_recorder,
+    flight_snapshot,
+    next_request_id,
+)
 from raft_tpu.obs.profiler import profile
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.obs.registry import (
@@ -72,7 +87,15 @@ from raft_tpu.obs.spans import (
     span,
     spans_snapshot,
 )
-from raft_tpu.obs import cost, health, quality, slowlog, spans, xla_events
+from raft_tpu.obs import (
+    cost,
+    flight,
+    health,
+    quality,
+    slowlog,
+    spans,
+    xla_events,
+)
 
 registry = default_registry  # `obs.registry()` reads as the obvious accessor
 
@@ -84,6 +107,7 @@ def install() -> None:
     reg = default_registry()
     reg.register_provider("spans", spans_snapshot)
     reg.register_provider("slow_queries", slowlog_snapshot)
+    reg.register_provider("flight", flight_snapshot)
 
 
 def snapshot():
@@ -95,6 +119,7 @@ def snapshot():
 __all__ = [
     "CostReport",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LabelCardinalityError",
@@ -105,10 +130,13 @@ __all__ = [
     "analyze_compiled",
     "cost",
     "current_span",
+    "default_recorder",
     "default_registry",
     "finish_span",
+    "flight",
     "health",
     "install",
+    "next_request_id",
     "open_span",
     "profile",
     "quality",
@@ -122,6 +150,7 @@ __all__ = [
     "snapshot_json",
     "span",
     "spans",
+    "to_openmetrics",
     "to_prometheus",
     "write_snapshot",
     "xla_events",

@@ -60,9 +60,7 @@ def _fmt_float(x: float) -> str:
     return repr(float(x))
 
 
-def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
-    """Render ``registry`` (default: process registry) as Prometheus text."""
-    reg = registry if registry is not None else default_registry()
+def _render(reg: MetricsRegistry, openmetrics: bool) -> str:
     lines = []
     for m in sorted(reg.metrics(), key=lambda m: m.name):
         name = _sanitize(m.name)
@@ -79,20 +77,58 @@ def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
             for k in sorted(data.keys()):
                 d = data[k]
                 cum = 0
+                exemplars = d.get("exemplars") or {}
                 edges = list(m.buckets) + [float("inf")]
-                for edge, n in zip(edges, d["bucket_counts"]):
+                for i, (edge, n) in enumerate(zip(edges, d["bucket_counts"])):
                     cum += n
-                    lines.append(
+                    line = (
                         f"{_fmt_series(name + '_bucket', k, {'le': _fmt_float(edge)})}"
                         f" {cum}"
                     )
+                    if openmetrics and i in exemplars:
+                        # OpenMetrics exemplar: the bucket's retained
+                        # request/span id + the observed value it came with
+                        value, ex_id = exemplars[i]
+                        line += (
+                            f' # {{request_id="{_escape_value(str(ex_id))}"}}'
+                            f" {_fmt_float(value)}"
+                        )
+                    lines.append(line)
                 lines.append(
                     f"{_fmt_series(name + '_sum', k)} {_fmt_float(d['sum'])}"
                 )
                 lines.append(
                     f"{_fmt_series(name + '_count', k)} {d['count']}"
                 )
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n" if lines else ""
+
+
+def to_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render ``registry`` (default: process registry) as Prometheus text.
+
+    Classic text exposition 0.0.4 — deliberately exemplar-free, because
+    plain-Prometheus scrapers reject the OpenMetrics exemplar syntax.
+    Use :func:`to_openmetrics` for the exemplar-bearing document.
+    """
+    reg = registry if registry is not None else default_registry()
+    return _render(reg, openmetrics=False)
+
+
+def to_openmetrics(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render ``registry`` as OpenMetrics text with histogram exemplars.
+
+    Identical to :func:`to_prometheus` except each ``_bucket`` line whose
+    bucket retains an exemplar gains the OpenMetrics suffix
+    ``# {request_id="req-123"} <observed value>`` — the hop from a fat
+    p99 bucket to the flight recorder's record of that request — and the
+    document ends with the mandatory ``# EOF`` marker.  Serve scrape
+    endpoints that negotiate ``application/openmetrics-text`` should
+    return this form.
+    """
+    reg = registry if registry is not None else default_registry()
+    return _render(reg, openmetrics=True)
 
 
 def snapshot_json(registry: Optional[MetricsRegistry] = None,

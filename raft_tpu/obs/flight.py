@@ -1,0 +1,328 @@
+"""Always-on flight recorder: the last N batches, dumpable post-hoc.
+
+When ``healthz()`` flips to UNHEALTHY or the recall alarm fires at 3am,
+aggregates answer *that* something went wrong; the flight recorder
+answers *which requests were in flight* and where their milliseconds
+went.  Like the post-hoc per-operation trace artifacts of the Ragged
+Paged Attention tooling (arxiv 2604.15464), no live profiler session is
+required: the batcher feeds every completed (or failed) batch — member
+request ids, per-request timelines reconstructed from the stage timers
+it already keeps — into a bounded ring, and :func:`dump` writes both a
+JSON snapshot and a Chrome-trace-event file loadable straight into
+https://ui.perfetto.dev.
+
+Triggers (all debounced through :func:`auto_dump`, so one incident
+produces one artifact, not one per symptom):
+
+- health transition to UNHEALTHY (:mod:`raft_tpu.obs.health`);
+- quality-alarm edge (:mod:`raft_tpu.obs.quality`);
+- a hot-path recompile after warmup (the batcher);
+- a batch exception on either dispatch path (the batcher).
+
+Env knobs: ``RAFT_TPU_FLIGHT_CAP`` (ring size, batch records, default
+256), ``RAFT_TPU_FLIGHT_DIR`` (auto-dump directory, default the system
+temp dir), ``RAFT_TPU_FLIGHT_DEBOUNCE_S`` (minimum seconds between
+auto-dumps, default 60).  ``RAFT_TPU_OBS_DISABLED`` / ``set_enabled``
+turn recording off entirely (the bench's A/B leg measures the delta).
+
+Recording cost: one dict build + deque append per *batch* (not per
+request), on the completion path — after futures are already resolved.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import raft_tpu.obs.spans as _spans
+from raft_tpu.obs.registry import default_registry
+
+#: default ring capacity (batch records)
+DEFAULT_CAP = 256
+
+#: default minimum seconds between auto-dumps
+DEFAULT_DEBOUNCE_S = 60.0
+
+# process-wide monotonically increasing request ids, assigned at
+# MicroBatcher.submit (itertools.count.__next__ is atomic in CPython)
+_req_ids = itertools.count(1)
+
+
+def next_request_id() -> int:
+    """The next request id — assigned once per submitted request."""
+    return next(_req_ids)
+
+
+def _env_cap() -> int:
+    try:
+        return max(1, int(os.environ.get("RAFT_TPU_FLIGHT_CAP", DEFAULT_CAP)))
+    except ValueError:
+        return DEFAULT_CAP
+
+
+def _env_debounce_s() -> float:
+    try:
+        return max(0.0, float(
+            os.environ.get("RAFT_TPU_FLIGHT_DEBOUNCE_S", DEFAULT_DEBOUNCE_S)
+        ))
+    except ValueError:
+        return DEFAULT_DEBOUNCE_S
+
+
+def _env_dir() -> str:
+    return os.environ.get("RAFT_TPU_FLIGHT_DIR") or tempfile.gettempdir()
+
+
+class FlightRecorder:
+    """Bounded ring of recent batch/event records + dump machinery.
+
+    One instance normally lives for the whole process (module-level
+    :func:`default_recorder`); tests build private ones.  All methods are
+    thread-safe; :meth:`record_batch` is the only one on a serving path
+    and costs a lock + deque append.
+    """
+
+    def __init__(self, cap: Optional[int] = None,
+                 debounce_s: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=cap if cap is not None else _env_cap())
+        self._recorded = 0          # total records ever (ring overwrites)
+        self._dump_seq = 0
+        self._last_dump: Optional[Dict[str, object]] = None
+        self._last_auto = float("-inf")   # monotonic stamp of last auto-dump
+        self._debounce_s = (
+            debounce_s if debounce_s is not None else _env_debounce_s()
+        )
+
+    # -- recording -----------------------------------------------------------
+    def record_batch(self, record: Dict[str, object]) -> None:
+        """Append one batch record (built by the batcher's completion
+        path).  No-op when obs is disabled, so ``RAFT_TPU_OBS_DISABLED``
+        really does zero the recorder's footprint."""
+        if not _spans.enabled():
+            return
+        with self._lock:
+            self._ring.append(record)
+            self._recorded += 1
+
+    def record_event(self, kind: str, **fields: object) -> None:
+        """Append one point-in-time event (e.g. a replicated-searcher
+        rebuild) so incident dumps carry it next to the affected batches."""
+        if not _spans.enabled():
+            return
+        rec = {"kind": kind, "t": time.perf_counter(), **fields}
+        with self._lock:
+            self._ring.append(rec)
+            self._recorded += 1
+
+    # -- reading -------------------------------------------------------------
+    def records(self) -> List[Dict[str, object]]:
+        """Ring contents, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def last_dump(self) -> Optional[Dict[str, object]]:
+        """``{"path", "trace_path", "reason", "unix_time"}`` of the most
+        recent dump, or None — surfaced by ``SearchService.healthz()``."""
+        with self._lock:
+            return dict(self._last_dump) if self._last_dump else None
+
+    def snapshot(self) -> Dict[str, object]:
+        """Provider section for registry snapshots."""
+        with self._lock:
+            return {
+                "cap": self._ring.maxlen,
+                "records": len(self._ring),
+                "recorded_total": self._recorded,
+                "last_dump": dict(self._last_dump) if self._last_dump else None,
+            }
+
+    # -- dumping -------------------------------------------------------------
+    def dump(self, directory: Optional[str] = None,
+             reason: str = "manual") -> str:
+        """Write the ring as ``flight_<seq>_<reason>.json`` plus a Chrome
+        trace-event file (``.trace.json``) into ``directory`` (default
+        ``RAFT_TPU_FLIGHT_DIR``, else the system temp dir).  Returns the
+        JSON snapshot path."""
+        directory = directory or _env_dir()
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            records = list(self._ring)
+            self._dump_seq += 1
+            seq = self._dump_seq
+        now = time.time()
+        stem = f"flight_{seq:04d}_{reason}"
+        path = os.path.join(directory, stem + ".json")
+        trace_path = os.path.join(directory, stem + ".trace.json")
+        snapshot = {
+            "schema": "raft_tpu.flight",
+            "reason": reason,
+            "unix_time": now,
+            "records": records,
+        }
+        with open(path, "w") as f:
+            json.dump(snapshot, f, indent=2, default=str)
+        with open(trace_path, "w") as f:
+            json.dump({"traceEvents": trace_events(records)}, f, default=str)
+        info = {
+            "path": path,
+            "trace_path": trace_path,
+            "reason": reason,
+            "unix_time": now,
+        }
+        with self._lock:
+            self._last_dump = info
+        default_registry().counter(
+            "raft_tpu_flight_dumps_total",
+            help="flight-recorder dumps written",
+        ).inc(reason=reason)
+        return path
+
+    def auto_dump(self, reason: str) -> Optional[str]:
+        """Debounced :meth:`dump` for incident triggers.  One incident
+        usually trips several triggers (the quality alarm fires, then the
+        next ``healthz()`` goes UNHEALTHY); within the debounce window
+        only the first writes an artifact.  Never raises — these calls
+        sit on health/alarm/error paths that must not gain failure modes.
+        """
+        if not _spans.enabled():
+            return None
+        with self._lock:
+            now = time.monotonic()
+            if now - self._last_auto < self._debounce_s:
+                default_registry().counter(
+                    "raft_tpu_flight_dumps_suppressed_total",
+                    help="auto-dumps suppressed by the debounce window",
+                ).inc(reason=reason)
+                return None
+            self._last_auto = now
+        try:
+            return self.dump(reason=reason)
+        except Exception:  # noqa: BLE001 — incident paths must not fail
+            return None
+
+    def reset(self) -> None:
+        """Clear the ring, debounce state and last-dump pointer; re-read
+        the env knobs (tests / long-lived REPLs)."""
+        with self._lock:
+            self._ring = deque(maxlen=_env_cap())
+            self._recorded = 0
+            self._last_dump = None
+            self._last_auto = float("-inf")
+            self._debounce_s = _env_debounce_s()
+
+
+def trace_events(records: List[Dict[str, object]]) -> List[Dict[str, object]]:
+    """Flatten batch records into Chrome trace events (Perfetto-loadable).
+
+    Track layout: tid 1 carries one complete ("X") slice per batch with
+    the stage sub-slices laid end to end from the batch pickup stamp
+    (reconstructed from the recorded durations — the recorder adds no
+    clocks of its own); tid 2 carries one slice per member request
+    spanning submit → resolve.  Point events (``record_event``) become
+    instant ("i") events.  Timestamps are ``time.perf_counter`` seconds
+    scaled to microseconds — relative, which is all Perfetto needs.
+    """
+    events: List[Dict[str, object]] = [
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "batches"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "requests"}},
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "raft_tpu.serve"}},
+    ]
+    for rec in records:
+        if "t_pickup" not in rec:  # a record_event point, not a batch
+            events.append({
+                "ph": "i", "pid": 1, "tid": 1, "s": "p",
+                "name": str(rec.get("kind", "event")),
+                "ts": float(rec.get("t", 0.0)) * 1e6,
+                "args": {k: v for k, v in rec.items() if k != "t"},
+            })
+            continue
+        t_pickup = float(rec.get("t_pickup", 0.0))
+        t_done = float(rec.get("t_done", t_pickup))
+        label = f"batch seq={rec.get('seq')} b{rec.get('bucket')}"
+        if rec.get("error"):
+            label += " ERROR"
+        events.append({
+            "ph": "X", "pid": 1, "tid": 1, "name": label,
+            "ts": t_pickup * 1e6,
+            "dur": max(0.0, t_done - t_pickup) * 1e6,
+            "args": {
+                "index": rec.get("index"),
+                "request_ids": rec.get("request_ids"),
+                "rows": rec.get("rows"),
+                "compiles": rec.get("compiles"),
+                "error": rec.get("error"),
+            },
+        })
+        offset = t_pickup
+        for stage, dur in (rec.get("stages_s") or {}).items():
+            dur = float(dur)
+            events.append({
+                "ph": "X", "pid": 1, "tid": 1, "name": stage,
+                "ts": offset * 1e6, "dur": max(0.0, dur) * 1e6,
+            })
+            offset += max(0.0, dur)
+        for req in rec.get("requests") or ():
+            t_submit = float(req.get("submit", t_pickup))
+            t_resolve = float(req.get("resolve", t_done))
+            events.append({
+                "ph": "X", "pid": 1, "tid": 2,
+                "name": f"req {req.get('id')}",
+                "ts": t_submit * 1e6,
+                "dur": max(0.0, t_resolve - t_submit) * 1e6,
+                "args": {k: v for k, v in req.items()
+                         if k not in ("submit", "resolve")},
+            })
+    return events
+
+
+# ---------------------------------------------------------------------------
+# the process-wide default recorder + module-level conveniences
+
+_default = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    return _default
+
+
+def record_batch(record: Dict[str, object]) -> None:
+    _default.record_batch(record)
+
+
+def record_event(kind: str, **fields: object) -> None:
+    _default.record_event(kind, **fields)
+
+
+def records() -> List[Dict[str, object]]:
+    return _default.records()
+
+
+def dump(directory: Optional[str] = None, reason: str = "manual") -> str:
+    return _default.dump(directory, reason=reason)
+
+
+def auto_dump(reason: str) -> Optional[str]:
+    return _default.auto_dump(reason)
+
+
+def last_dump() -> Optional[Dict[str, object]]:
+    return _default.last_dump()
+
+
+def flight_snapshot() -> Dict[str, object]:
+    """Provider section for registry snapshots."""
+    return _default.snapshot()
+
+
+def reset() -> None:
+    _default.reset()

@@ -30,9 +30,11 @@ point is an actionable default, not a tunable anomaly detector:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from raft_tpu.obs import flight
 from raft_tpu.obs.registry import MetricsRegistry, default_registry
 
 OK = "OK"
@@ -166,6 +168,19 @@ def device_memory_check() -> Dict[str, str]:
     return _check(OK, detail)
 
 
+# previous overall verdict, for edge detection: the flight recorder dumps
+# on the *transition* into UNHEALTHY, not on every red healthz() poll
+_transition_lock = threading.Lock()
+_prev_overall: Optional[str] = None
+
+
+def reset_transitions() -> None:
+    """Forget the last seen overall verdict (test isolation)."""
+    global _prev_overall
+    with _transition_lock:
+        _prev_overall = None
+
+
 def build_report(
     probes: Dict[str, IndexProbe],
     registry: Optional[MetricsRegistry] = None,
@@ -174,8 +189,12 @@ def build_report(
 
     One gauge series per index plus ``index=overall`` — the overall
     verdict also folds in the device memory check, which is a property of
-    the process, not of any one index.
+    the process, not of any one index.  A transition *into* UNHEALTHY
+    triggers a debounced flight-recorder auto-dump, and the report's
+    ``flight`` key carries the most recent dump's paths so the healthz
+    payload that announces the incident also says where the evidence is.
     """
+    global _prev_overall
     reg = registry if registry is not None else default_registry()
     gauge = reg.gauge(
         "raft_tpu_health",
@@ -191,8 +210,14 @@ def build_report(
     mem = device_memory_check()
     overall = worst(mem["status"], *statuses)
     gauge.set(VERDICT_VALUES[overall], index="overall")
+    with _transition_lock:
+        went_unhealthy = overall == UNHEALTHY and _prev_overall != UNHEALTHY
+        _prev_overall = overall
+    if went_unhealthy:
+        flight.auto_dump("health_unhealthy")
     return {
         "status": overall,
         "memory": mem,
         "indexes": indexes,
+        "flight": flight.last_dump(),
     }

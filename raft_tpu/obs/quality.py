@@ -44,6 +44,7 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 from raft_tpu.core.logger import child as _child_logger
+from raft_tpu.obs import flight
 from raft_tpu.obs.registry import MetricsRegistry, default_registry
 from raft_tpu.stats.metrics import rank_displacement, recall_at_k
 
@@ -262,6 +263,10 @@ class QualityAuditor:
                 sample.name, sample.version, ewma, self.threshold,
                 recall, int(st["n"]),
             )
+            # the alarm edge is an incident: capture the in-flight batches
+            # while they are still in the recorder ring (debounced, so a
+            # subsequent UNHEALTHY healthz() does not double-dump)
+            flight.auto_dump("quality_alarm")
             cb = self.on_degraded
             if cb is not None:
                 try:

@@ -160,13 +160,16 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("bucket_counts", "sum", "count", "reservoir")
+    __slots__ = ("bucket_counts", "sum", "count", "reservoir", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.bucket_counts = [0] * n_buckets  # non-cumulative, per bucket
         self.sum = 0.0
         self.count = 0
         self.reservoir: List[float] = []
+        #: bucket index -> (observed value, exemplar id); last-write-wins,
+        #: so storage is bounded by the ladder length, not traffic
+        self.exemplars: Dict[int, Tuple[float, str]] = {}
 
 
 class Histogram(_Metric):
@@ -193,7 +196,12 @@ class Histogram(_Metric):
     def _new_series(self) -> _HistSeries:
         return _HistSeries(len(self.buckets) + 1)  # +1: +Inf overflow
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels: str) -> None:
+        """Record ``value``.  ``exemplar`` (a request/span id) is retained
+        per destination bucket, last-write-wins — the link from a fat p99
+        bucket back to a concrete flight-recorder entry.  ``exemplar`` is
+        a reserved keyword, not a label."""
         value = float(value)
         # bisect outside the lock — buckets are immutable
         lo, hi = 0, len(self.buckets)
@@ -208,6 +216,8 @@ class Histogram(_Metric):
             s.bucket_counts[lo] += 1
             s.sum += value
             s.count += 1
+            if exemplar is not None:
+                s.exemplars[lo] = (value, str(exemplar))
             res = s.reservoir
             if len(res) >= self._reservoir_cap:
                 # ring overwrite: keep a sliding window of recent values
@@ -233,8 +243,19 @@ class Histogram(_Metric):
                     "sum": float(s.sum),
                     "count": int(s.count),
                     "reservoir": np.asarray(s.reservoir, dtype=np.float64),
+                    "exemplars": dict(s.exemplars),
                 }
         return out
+
+    def bucket_edge(self, i: int) -> float:
+        """Upper edge of bucket ``i`` (``inf`` for the overflow slot)."""
+        return self.buckets[i] if i < len(self.buckets) else float("inf")
+
+    def clear_exemplars(self) -> None:
+        """Drop retained exemplars on every series (test isolation)."""
+        with self._lock:
+            for s in self._series.values():
+                s.exemplars.clear()
 
     def snapshot_series(self, k: LabelValue, data: Dict[str, object]
                         ) -> Dict[str, object]:
@@ -247,6 +268,18 @@ class Histogram(_Metric):
         if getattr(arr, "size", 0):
             for q in (50, 90, 99):
                 out[f"p{q}_ms"] = float(np.percentile(arr, q) * 1e3)
+        exemplars = data.get("exemplars")
+        if exemplars:
+            out["exemplars"] = [
+                {
+                    # "+Inf" keeps the overflow edge strict-JSON-safe
+                    "le": (e if e != float("inf") else "+Inf"),
+                    "value": v,
+                    "id": ex,
+                }
+                for i, (v, ex) in sorted(exemplars.items())
+                for e in (self.bucket_edge(i),)
+            ]
         return out
 
 
@@ -337,6 +370,13 @@ class MetricsRegistry:
             except Exception as exc:  # provider bugs must not kill snapshots
                 out[name] = {"error": repr(exc)}
         return out
+
+    def clear_exemplars(self) -> None:
+        """Drop retained histogram exemplars without touching counts —
+        the between-tests reset (exemplars are last-write-wins state)."""
+        for m in self.metrics():
+            if isinstance(m, Histogram):
+                m.clear_exemplars()
 
     def reset(self) -> None:
         """Drop all metrics and providers (tests / long-lived REPLs)."""

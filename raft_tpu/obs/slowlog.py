@@ -36,8 +36,19 @@ _threshold_s = float(os.environ.get("RAFT_TPU_SLOW_QUERY_MS", "250")) * 1e-3
 
 
 def configure(threshold_ms: Optional[float]) -> None:
-    """Set the slow threshold; None disables the log entirely."""
+    """Set the slow threshold; None disables the log entirely.
+
+    Rejects negative thresholds: the old behaviour silently armed an
+    every-query log (anything is slower than -5 ms), which reads like
+    "disabled" but WARNING-spams instead.  Use ``None`` or ``0`` to log
+    everything deliberately, a positive value to filter.
+    """
     global _threshold_s
+    if threshold_ms is not None and float(threshold_ms) < 0:
+        raise ValueError(
+            f"slow-query threshold must be >= 0 ms (or None to disable), "
+            f"got {threshold_ms}"
+        )
     _threshold_s = None if threshold_ms is None else float(threshold_ms) * 1e-3
 
 

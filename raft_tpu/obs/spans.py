@@ -31,13 +31,19 @@ from typing import Dict, Iterator, List, Optional
 
 from raft_tpu.obs.registry import default_registry
 
-#: ring of recently finished root spans (tests / debugging / slow log)
-_RECENT_CAP = 512
+def _ring_cap() -> int:
+    """Recent-span ring capacity: ``RAFT_TPU_SPAN_RING``, default 512."""
+    try:
+        return max(1, int(os.environ.get("RAFT_TPU_SPAN_RING", "512")))
+    except ValueError:
+        return 512
+
 
 _ids = itertools.count(1)  # itertools.count.__next__ is atomic in CPython
 _tls = threading.local()
 _recent_lock = threading.Lock()
-_recent: deque = deque(maxlen=_RECENT_CAP)
+#: ring of recently finished root spans (tests / debugging / slow log)
+_recent: deque = deque(maxlen=_ring_cap())
 
 _disabled = bool(os.environ.get("RAFT_TPU_OBS_DISABLED"))
 
@@ -135,13 +141,33 @@ def span(name: str) -> Iterator[Optional[Span]]:
         _record_finished(sp, parent)
 
 
+def set_ring_capacity(cap: Optional[int] = None) -> int:
+    """Resize the recent-span ring, keeping its newest entries.  With no
+    argument, re-reads ``RAFT_TPU_SPAN_RING`` — the hook the conftest
+    reset fixture and long-lived REPLs use.  Returns the new capacity."""
+    global _recent
+    new_cap = _ring_cap() if cap is None else max(1, int(cap))
+    with _recent_lock:
+        if _recent.maxlen != new_cap:
+            _recent = deque(_recent, maxlen=new_cap)
+    return new_cap
+
+
+def clear_recent() -> None:
+    """Drop the recent-span ring contents (test isolation)."""
+    with _recent_lock:
+        _recent.clear()
+
+
 def _record_finished(sp: Span, parent: Optional[Span]) -> None:
     reg = default_registry()
     try:
+        # the span id rides along as a per-bucket exemplar, so a fat p99
+        # bucket in the scrape links back to a concrete recorded span
         reg.histogram(
             "raft_tpu_span_seconds",
             help="wall time per traced operation",
-        ).observe(sp.duration_s, span=sp.name)
+        ).observe(sp.duration_s, exemplar=f"span-{sp.span_id}", span=sp.name)
     except Exception:
         # span names are static strings in practice; a pathological dynamic
         # name tripping the cardinality cap must not break the traced API

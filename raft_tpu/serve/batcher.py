@@ -31,6 +31,16 @@ the original fully-serial dispatch, byte for byte.  Steady-state QPS at
 depth > 1 is bounded by the *max* of the host and device stage times
 rather than their sum (``bench.py serve`` measures the A/B).
 
+Request identity: every :meth:`MicroBatcher.submit` assigns a
+process-wide monotonically increasing request id (returned on the future
+as ``fut.request_id``).  Both dispatch paths feed each completed or
+failed batch — member request ids plus per-request timelines
+reconstructed from the stage stamps above — to the always-on
+:mod:`raft_tpu.obs.flight` recorder, and auto-dump it on a hot-path
+recompile or batch exception.  The only hot-path additions are the
+submit-time id assignment and one dict build per *batch* after futures
+resolve.
+
 Staging-buffer safety: completion is strictly FIFO and the semaphore
 caps in-flight batches at ``pipeline_depth``, so by the time a bucket's
 ring slot (one of ``pipeline_depth`` per bucket) comes around again its
@@ -41,6 +51,7 @@ holds samples past the batch's lifetime.
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue as queue_mod
 import threading
@@ -53,7 +64,7 @@ import jax
 import numpy as np
 
 from raft_tpu.core.trace import trace_range
-from raft_tpu.obs import slowlog, spans
+from raft_tpu.obs import flight, slowlog, spans
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
 
 # search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k])
@@ -70,12 +81,14 @@ def _next_pow2(n: int) -> int:
 
 
 class _Request:
-    __slots__ = ("rows", "future", "t_submit")
+    __slots__ = ("rows", "future", "t_submit", "req_id")
 
-    def __init__(self, rows: np.ndarray, future: Future, t_submit: float):
+    def __init__(self, rows: np.ndarray, future: Future, t_submit: float,
+                 req_id: int):
         self.rows = rows
         self.future = future
         self.t_submit = t_submit
+        self.req_id = req_id
 
 
 class _InFlight:
@@ -85,7 +98,7 @@ class _InFlight:
     __slots__ = (
         "batch", "padded", "n", "bucket", "queue_waits", "t_pad",
         "inflight_wait", "t_dispatch", "t_enqueued", "dist", "ids",
-        "compiles", "sp", "done",
+        "compiles", "sp", "done", "seq", "t_pickup",
     )
 
     def __init__(self, batch: List[_Request]):
@@ -210,6 +223,9 @@ class MicroBatcher:
         # bench's idle-fraction figure (completion thread only)
         self._busy_s = 0.0
         self._busy_until = 0.0
+        # flight-recorder batch sequence (per batcher; request ids are
+        # process-wide, see obs.flight.next_request_id)
+        self._batch_seq = itertools.count(1)
         self.metrics.record_pipeline(self.pipeline_depth, 0)
         if start:
             self.start()
@@ -339,6 +355,10 @@ class MicroBatcher:
 
         Returns a future resolving to ``(distances [m, k], ids [m, k])``
         numpy arrays (the leading axis is squeezed away for 1-D input).
+        The future carries the request's process-wide monotonically
+        increasing id as ``fut.request_id`` — the handle that links a
+        caller's latency to its flight-recorder timeline and histogram
+        exemplar.
         """
         rows = np.asarray(queries, dtype=np.float32)
         squeeze = rows.ndim == 1
@@ -353,16 +373,19 @@ class MicroBatcher:
                 f"request of {rows.shape[0]} rows exceeds max_batch="
                 f"{self.max_batch}; split it client-side"
             )
+        req_id = flight.next_request_id()
         fut: Future = Future()
+        fut.request_id = req_id
         if squeeze:
             inner = fut
             fut = Future()
+            fut.request_id = req_id
             inner.add_done_callback(
                 lambda f, out=fut: _squeeze_result(f, out)
             )
-            req = _Request(rows, inner, time.perf_counter())
+            req = _Request(rows, inner, time.perf_counter(), req_id)
         else:
-            req = _Request(rows, fut, time.perf_counter())
+            req = _Request(rows, fut, time.perf_counter(), req_id)
         with self._cond:
             if self._stopping and (
                 self._thread is None or not self._thread.is_alive()
@@ -455,9 +478,62 @@ class MicroBatcher:
         with self._dispatch_lock:
             self._dispatch_locked(batch)
 
+    def _record_flight(
+        self,
+        *,
+        seq: int,
+        batch: List[_Request],
+        n: int,
+        bucket: int,
+        compiles: int,
+        t_pickup: float,
+        t_done: float,
+        stages_s: Dict[str, float],
+        waits_s: Dict[str, float],
+        error: Optional[str] = None,
+    ) -> None:
+        """Feed one completed (or failed) batch to the flight recorder.
+
+        ``stages_s`` holds the post-pickup stage durations in execution
+        order (the Chrome-trace builder lays them end to end from
+        ``t_pickup``); ``waits_s`` the pre-pickup waits (queue, in-flight
+        window).  All values come from stamps the dispatch paths already
+        take — this reconstructs, it does not measure.
+        """
+        if not spans.enabled():
+            return
+        stages_ms = {k: v * 1e3 for k, v in {**waits_s, **stages_s}.items()}
+        flight.record_batch({
+            "seq": seq,
+            "index": self.metrics.name,
+            "bucket": bucket,
+            "rows": n,
+            "compiles": compiles,
+            "request_ids": [req.req_id for req in batch],
+            "t_pickup": t_pickup,
+            "t_done": t_done,
+            "stages_s": stages_s,
+            "waits_s": waits_s,
+            "requests": [
+                {
+                    "id": req.req_id,
+                    "rows": req.rows.shape[0],
+                    "submit": req.t_submit,
+                    "batched": t_pickup,
+                    "resolve": t_done,
+                    "queue_ms": (t_pickup - req.t_submit) * 1e3,
+                    "latency_ms": (t_done - req.t_submit) * 1e3,
+                    "stages_ms": stages_ms,
+                }
+                for req in batch
+            ],
+            "error": error,
+        })
+
     def _dispatch_locked(self, batch: List[_Request]) -> None:
         if not batch:
             return
+        seq = next(self._batch_seq)
         t_start = time.perf_counter()
         # queue-wait ends the moment the batch is picked up: submit → here
         queue_waits = [t_start - r.t_submit for r in batch]
@@ -490,6 +566,15 @@ class MicroBatcher:
             dist = np.asarray(dist)
             ids = np.asarray(ids)
         except Exception as exc:  # noqa: BLE001 — fail the waiting futures
+            self._record_flight(
+                seq=seq, batch=batch, n=n, bucket=bucket,
+                compiles=compile_count() - c0,
+                t_pickup=t_start, t_done=time.perf_counter(),
+                stages_s={"pad": t_pad},
+                waits_s={"queue": max(queue_waits, default=0.0)},
+                error=repr(exc),
+            )
+            flight.auto_dump("batch_exception")
             for req in batch:
                 req.future.set_exception(exc)
             return
@@ -518,7 +603,23 @@ class MicroBatcher:
                 "dispatch": (t1 - t0,),
                 "device": (t2 - t1,),
             },
+            request_ids=[r.req_id for r in batch],
         )
+        self._record_flight(
+            seq=seq, batch=batch, n=n, bucket=bucket, compiles=compiles,
+            t_pickup=t_start, t_done=done,
+            stages_s={
+                "pad": t_pad,
+                "dispatch": t1 - t0,
+                "device": t2 - t1,
+                "copy_out": done - t2,
+            },
+            waits_s={"queue": max(queue_waits, default=0.0)},
+        )
+        if compiles and self._warm:
+            # a recompile on the warmed hot path is a shape leak: capture
+            # the surrounding traffic while it is still in the ring
+            flight.auto_dump("hot_recompile")
         if sp is not None:
             slowlog.maybe_record(
                 sp,
@@ -528,6 +629,7 @@ class MicroBatcher:
                     "requests": len(batch),
                     "bucket": bucket,
                     "compiles": compiles,
+                    "request_ids": [r.req_id for r in batch],
                 },
             )
 
@@ -600,6 +702,8 @@ class MicroBatcher:
         t_acquired = time.perf_counter()
         with self._dispatch_lock:
             rec = _InFlight(batch)
+            rec.seq = next(self._batch_seq)
+            rec.t_pickup = t_acquired
             rec.inflight_wait = t_acquired - t_arrive
             # queue-wait ends when the batch is picked up for dispatch
             rec.queue_waits = [t_acquired - r.t_submit for r in batch]
@@ -633,6 +737,18 @@ class MicroBatcher:
             except Exception as exc:  # noqa: BLE001 — fail only this batch
                 spans.finish_span(rec.sp)
                 self._inflight_sem.release()
+                self._record_flight(
+                    seq=rec.seq, batch=batch, n=n, bucket=bucket,
+                    compiles=compile_count() - c0,
+                    t_pickup=t_acquired, t_done=time.perf_counter(),
+                    stages_s={"pad": rec.t_pad},
+                    waits_s={
+                        "queue": max(rec.queue_waits, default=0.0),
+                        "inflight_wait": rec.inflight_wait,
+                    },
+                    error=repr(exc),
+                )
+                flight.auto_dump("batch_exception")
                 for req in batch:
                     req.future.set_exception(exc)
                 return None
@@ -674,6 +790,18 @@ class MicroBatcher:
             ids = np.asarray(rec.ids)
         except Exception as exc:  # noqa: BLE001 — fail only this batch
             spans.finish_span(rec.sp)
+            self._record_flight(
+                seq=rec.seq, batch=batch, n=rec.n, bucket=rec.bucket,
+                compiles=rec.compiles,
+                t_pickup=rec.t_pickup, t_done=time.perf_counter(),
+                stages_s={"pad": rec.t_pad, "dispatch": rec.t_dispatch},
+                waits_s={
+                    "queue": max(rec.queue_waits, default=0.0),
+                    "inflight_wait": rec.inflight_wait,
+                },
+                error=repr(exc),
+            )
+            flight.auto_dump("batch_exception")
             for req in batch:
                 req.future.set_exception(exc)
             return
@@ -719,7 +847,28 @@ class MicroBatcher:
                 "dispatch": (rec.t_dispatch,),
                 "device": (t_device,),
             },
+            request_ids=[r.req_id for r in batch],
         )
+        self._record_flight(
+            seq=rec.seq, batch=batch, n=rec.n, bucket=rec.bucket,
+            compiles=rec.compiles,
+            t_pickup=rec.t_pickup, t_done=done,
+            stages_s={
+                "pad": rec.t_pad,
+                "dispatch": rec.t_dispatch,
+                "completer_wait": max(0.0, t3 - rec.t_enqueued),
+                "device": t_device,
+                "copy_out": done - t4,
+            },
+            waits_s={
+                "queue": max(rec.queue_waits, default=0.0),
+                "inflight_wait": rec.inflight_wait,
+            },
+        )
+        if rec.compiles and self._warm:
+            # a recompile on the warmed hot path is a shape leak: capture
+            # the surrounding traffic while it is still in the ring
+            flight.auto_dump("hot_recompile")
         if rec.sp is not None:
             slowlog.maybe_record(
                 rec.sp,
@@ -729,6 +878,7 @@ class MicroBatcher:
                     "requests": len(batch),
                     "bucket": rec.bucket,
                     "compiles": rec.compiles,
+                    "request_ids": [r.req_id for r in batch],
                 },
             )
 

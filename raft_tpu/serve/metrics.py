@@ -133,11 +133,14 @@ class ServingMetrics:
         latencies_s,
         compiles: int,
         stages: Optional[Mapping[str, Iterable[float]]] = None,
+        request_ids: Optional[Iterable[int]] = None,
     ) -> None:
         """One dispatched batch: ``latencies_s`` holds one submit→complete
         latency per coalesced request (queue wait included); ``stages``
         maps stage name → iterable of per-batch (or per-request, for
-        ``queue``) stage durations in seconds."""
+        ``queue``) stage durations in seconds; ``request_ids`` (parallel
+        to ``latencies_s``) attaches each latency observation's request id
+        as a histogram exemplar, so a fat p99 bucket names the request."""
         now = time.perf_counter()
         with self._lock:
             self.requests += len(latencies_s)
@@ -155,10 +158,11 @@ class ServingMetrics:
                     )
                     for v in vals:
                         dq.append(float(v))
-        self._mirror_batch(n_real_rows, latencies_s, compiles, stages)
+        self._mirror_batch(n_real_rows, latencies_s, compiles, stages,
+                           request_ids)
 
-    def _mirror_batch(self, n_real_rows, latencies_s, compiles, stages
-                      ) -> None:
+    def _mirror_batch(self, n_real_rows, latencies_s, compiles, stages,
+                      request_ids=None) -> None:
         """Feed the obs registry (no-op for anonymous instances)."""
         if self.name is None:
             return
@@ -179,8 +183,12 @@ class ServingMetrics:
             "raft_tpu_serve_request_seconds",
             help="submit-to-complete request latency",
         )
-        for lat in latencies_s:
-            lat_h.observe(lat, **label)
+        ids = list(request_ids) if request_ids is not None else None
+        for i, lat in enumerate(latencies_s):
+            # the request id rides along as a per-bucket exemplar: the
+            # OpenMetrics scrape links the bucket to a flight-recorder entry
+            ex = f"req-{ids[i]}" if ids is not None and i < len(ids) else None
+            lat_h.observe(lat, exemplar=ex, **label)
         if stages:
             st_h = reg.histogram(
                 "raft_tpu_serve_stage_seconds",

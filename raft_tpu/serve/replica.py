@@ -173,6 +173,12 @@ class ReplicaGroup:
                 help="replicated searcher (re)builds, one per index "
                 "version/generation change",
             ).inc(index=name)
+            # a point event in the flight ring: an incident dump shows the
+            # rebuild (and its retrace cost) next to the batches it delayed
+            obs.flight.record_event(
+                "replica_rebuild", index=name,
+                version=key[0], generation=key[1],
+            )
         return cached[1](queries, k)
 
     def searcher(self, name: str, k: int):

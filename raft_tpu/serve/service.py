@@ -263,6 +263,11 @@ class SearchService:
         :func:`raft_tpu.obs.health.build_report`.  Also publishes the
         ``raft_tpu_health`` gauge (0=OK, 1=DEGRADED, 2=UNHEALTHY) so the
         verdict is scrapeable.
+
+        A transition *into* UNHEALTHY auto-dumps the flight recorder
+        (debounced), and the report's ``flight`` key carries the latest
+        dump's JSON + Chrome-trace paths — the payload that announces the
+        incident also says where the evidence landed.
         """
         self._refresh_capacity_gauges()
         auditor = self.auditor
@@ -326,6 +331,21 @@ class SearchService:
         except Exception:
             pass
         return obs.to_prometheus()
+
+    def openmetrics(self) -> str:
+        """The registry as OpenMetrics text, exemplars included.
+
+        Same refresh path as :meth:`prometheus`; serve this form to
+        scrapers that negotiate ``application/openmetrics-text`` — each
+        latency bucket's retained request-id exemplar links it to the
+        matching flight-recorder timeline (see :meth:`healthz`'s
+        ``flight`` key for the latest dump location).
+        """
+        try:
+            self.healthz()
+        except Exception:
+            pass
+        return obs.to_openmetrics()
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:

@@ -54,6 +54,32 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True)
+def _reset_obs_globals(monkeypatch, tmp_path):
+    """Isolate per-test observability state.
+
+    The flight recorder, health transition edge, recent-span ring and
+    histogram exemplars are process-wide by design; without a reset a
+    test's incident dump (or a leftover UNHEALTHY verdict) leaks into the
+    next test's assertions.  Auto-dumps are pointed at the test's tmp dir
+    so nothing lands in the real RAFT_TPU_FLIGHT_DIR / system temp.
+    Counters/gauges/histogram *counts* are deliberately left alone — the
+    existing suites assert on monotonic totals.
+    """
+    from raft_tpu.obs import flight, health, spans
+    from raft_tpu.obs.registry import default_registry
+
+    monkeypatch.setenv("RAFT_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    flight.reset()
+    health.reset_transitions()
+    yield
+    flight.reset()
+    health.reset_transitions()
+    spans.clear_recent()
+    spans.set_ring_capacity()
+    default_registry().clear_exemplars()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

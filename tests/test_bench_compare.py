@@ -266,3 +266,44 @@ def test_serve_pipeline_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_flight_recorder_overhead_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the flight-recorder A/B: run ``bench.py flight``
+    (recorder on vs ``obs.set_enabled(False)``) and gate it with
+    ``bench.py compare`` against the frozen record.  The run must show the
+    recorder is effectively free on the serve hot path (the tentpole's
+    "always-on" claim): every dispatched batch recorded when on, zero
+    records when off, zero recompiles, and QPS within tolerance of the
+    recorder-off arm."""
+    candidate = str(tmp_path / "flight_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "flight"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "flight leg recompiled on the hot path"
+    on, off = line["recorder_on"], line["recorder_off"]
+    assert on["recorded_batches"] >= on["batches"] > 0
+    assert off["recorded_batches"] == 0
+    # the acceptance bound is 3%; allow CI scheduling noise on top of it
+    assert line["qps_ratio"] >= 0.90, (
+        f"recorder overhead out of tolerance: {line['overhead_pct']}%"
+    )
+
+    baseline = os.path.join(
+        REPO, "benchmarks", "BENCH_flight_recorder_r07.json"
+    )
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

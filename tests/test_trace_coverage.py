@@ -144,6 +144,38 @@ def test_pipelined_dispatch_reports_detached_spans():
     )
 
 
+def test_request_ids_propagate_through_serve_entry_points():
+    """Static enforcement of the request-id thread: every request gets a
+    process-wide id at submit, and both dispatch paths must hand the
+    member ids to the flight recorder, the metrics exemplars and the slow
+    log.  A refactor that drops any link silently reverts serving to
+    anonymous batches — aggregates with no way back to the request."""
+    from raft_tpu.serve.batcher import MicroBatcher, _Request
+
+    submit_src = inspect.getsource(MicroBatcher.submit)
+    assert "next_request_id" in submit_src, (
+        "MicroBatcher.submit no longer assigns flight.next_request_id"
+    )
+    assert "request_id" in submit_src, (
+        "MicroBatcher.submit must expose the id as fut.request_id"
+    )
+    assert "req_id" in _Request.__slots__, (
+        "_Request dropped its req_id slot; ids cannot cross the queue"
+    )
+    for path in (MicroBatcher._dispatch_locked, MicroBatcher._complete):
+        src = inspect.getsource(path)
+        assert "_record_flight" in src, (
+            f"{path.__name__} no longer feeds the flight recorder"
+        )
+        assert "request_ids" in src, (
+            f"{path.__name__} dropped request ids from its records"
+        )
+    record_src = inspect.getsource(MicroBatcher._record_flight)
+    assert "req.req_id" in record_src, (
+        "_record_flight must carry member request ids into batch records"
+    )
+
+
 def test_serve_traced_labels_match_and_are_unique():
     seen = {}
     for dotted, fn, expected in _serve_methods():
