@@ -167,6 +167,9 @@ def main() -> None:
     if "serve" in sys.argv[1:]:
         run_serve_leg()
         return
+    if "shard" in sys.argv[1:]:
+        run_shard_leg()
+        return
     if "obs" in sys.argv[1:]:
         run_obs_leg()
         return
@@ -408,6 +411,10 @@ def run_leg(leg: str) -> None:
             "n_probes": n_probes,
             "strategy": strategy,
             "pallas": pallas_used,
+            # the attribution field the regression gate reports on — the
+            # measured A/B routing, not the env default bench_record
+            # would otherwise stamp
+            "kernel_path": {"pallas": pallas_used},
             "build_s": round(build_s, 1),
             "exact_qps": round(exact_qps, 1),
             "n": n,
@@ -580,6 +587,122 @@ def run_serve_leg() -> None:
             "warmup_compiles": head["warmup_compiles"],
             "requests": n_requests,
             "n": n,
+        }
+    )
+
+
+def run_shard_leg() -> None:
+    """``python bench.py shard`` — index-sharding A/B benchmark (CPU,
+    8 forced host devices).
+
+    Three arms over the same ivf_flat index and query batch:
+
+    - ``single``: the plain one-device search (the 1-device baseline);
+    - ``replicated``: ReplicaGroup-style query sharding — all 8 devices
+      hold the FULL index, queries split across them;
+    - ``sharded``: ShardedIndex — each device holds ~1/8 of the lists,
+      queries replicate, one cross-shard select_k merges.
+
+    The headline value is the sharded-arm QPS (gated ±rtol vs the frozen
+    record like every leg), but the number this leg exists to freeze is
+    ``bytes_shrink_x``: per-device index bytes, replicated vs sharded —
+    the capacity story.  ``n_probes`` is exhaustive, so all three arms
+    return identical ids (recall 1.0 between arms is asserted, not
+    measured) and hot-path recompiles must read 0 after warmup.
+    """
+    # 8 virtual host devices; must land in XLA_FLAGS before jax imports
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu.comms.comms import local_comms
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serve.metrics import compile_count, install_compile_listener
+    from raft_tpu.serve.replica import make_replicated_search
+    from raft_tpu.serve.shard import ShardedIndex
+    from raft_tpu.stats import recall_at_k
+
+    install_compile_listener()
+    n_dev = len(jax.devices())
+    n, d, k, n_q = 32_768, 64, 10, 1024
+    n_lists = 64
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_q, d), dtype=np.float32)
+
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), dataset)
+    # exhaustive probing: every arm sees every list, so ids are identical
+    # across arms and the A/B compares pure dispatch/layout cost
+    sp = ivf_flat.SearchParams(n_probes=n_lists)
+
+    def single_fn(q):
+        return ivf_flat.search(sp, index, q, k)
+
+    replicated_fn = make_replicated_search(
+        local_comms(n_dev),
+        lambda q_shard, kk: ivf_flat.search(sp, index, q_shard, kk),
+    )
+    sharded = ShardedIndex.from_index(index, search_params=sp, label="bench")
+
+    full_bytes = sum(
+        int(np.asarray(a).nbytes)
+        for a in (index.centers, index.list_data, index.list_index,
+                  index.list_sizes, index.list_norms)
+    )
+    per_dev_sharded = sharded.per_shard_bytes()[0]
+    shrink = full_bytes / per_dev_sharded if per_dev_sharded else None
+
+    arms = {
+        "single": single_fn,
+        "replicated": lambda q: replicated_fn(q, k),
+        "sharded": lambda q: sharded.search(q, k),
+    }
+    results, ids_by_arm = {}, {}
+    for name, fn in arms.items():
+        t = timeit(fn, queries)  # timeit warms up first — compiles land
+        c1 = compile_count()     # before this read, recompiles after it
+        _, ids = fn(queries)
+        ids_by_arm[name] = np.asarray(ids)
+        results[name] = {
+            "qps": round(n_q / t, 1),
+            "latency_ms": round(t * 1e3, 2),
+            "recompiles": compile_count() - c1,
+        }
+    base_ids = ids_by_arm["single"]
+    for name in ("replicated", "sharded"):
+        r = recall_at_k(ids_by_arm[name], base_ids)
+        results[name]["recall_vs_single"] = round(float(r), 4)
+    assert results["sharded"]["recall_vs_single"] >= 0.999, (
+        "sharded arm diverged from single-device ids at exhaustive probing"
+    )
+
+    results["replicated"]["per_device_bytes"] = full_bytes
+    results["sharded"]["per_device_bytes"] = per_dev_sharded
+    _emit(
+        {
+            "metric": (
+                f"shard_index_qps_ivf_flat_n{n // 1024}k_k{k}_s{n_dev}"
+            ),
+            "value": results["sharded"]["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "devices": n_dev,
+            "arms": results,
+            "bytes_shrink_x": round(shrink, 2) if shrink else None,
+            "merge_dtype": str(sharded.merge_dtype or "float32"),
+            "recall": results["sharded"]["recall_vs_single"],
+            "recompiles": sum(a["recompiles"] for a in results.values()),
+            "n": n,
+            "n_lists": n_lists,
+            "queries": n_q,
         }
     )
 

@@ -55,17 +55,50 @@ def from_json(path: str) -> List[RunResult]:
 
 # ---- schema-versioned bench records ----------------------------------------
 
+def kernel_path(
+    metric: Optional[str] = None,
+    storage_dtype=None,
+    *,
+    pallas: Optional[bool] = None,
+) -> Dict[str, object]:
+    """Which kernel implementation a record's numbers are attributable to.
+
+    Every record carries this (stamped by :func:`bench_record` if the leg
+    didn't set it), so "pallas won X%" claims are checkable against the
+    record instead of against memory.  Pass ``pallas=`` when the leg
+    measured the routing itself (the accel A/B leg does); pass
+    ``metric``/``storage_dtype`` to ask the shared
+    :func:`~raft_tpu.neighbors._common.pallas_scan_enabled` gate; with
+    neither, fall back to the ``RAFT_TPU_PALLAS`` env opt-in alone.
+    """
+    if pallas is None:
+        if metric is not None and storage_dtype is not None:
+            from raft_tpu.neighbors._common import pallas_scan_enabled
+
+            pallas = pallas_scan_enabled(metric, storage_dtype)
+        else:
+            pallas = os.environ.get("RAFT_TPU_PALLAS") == "1"
+    return {"pallas": bool(pallas)}
+
+
 def bench_record(payload: Dict[str, object]) -> Dict[str, object]:
-    """Wrap one bench leg's JSON payload in the versioned envelope."""
+    """Wrap one bench leg's JSON payload in the versioned envelope.
+
+    Stamps a default :func:`kernel_path` into payloads that lack one —
+    additive, so records written before the field existed still load and
+    compare (absence is simply not reported).
+    """
     if not isinstance(payload, dict) or "metric" not in payload:
         raise ValueError(
             "bench payload must be a dict with a 'metric' key, got "
             f"{type(payload).__name__}"
         )
+    rec = dict(payload)
+    rec.setdefault("kernel_path", kernel_path())
     return {
         "schema": "raft_tpu.bench",
         "schema_version": BENCH_SCHEMA_VERSION,
-        "record": dict(payload),
+        "record": rec,
     }
 
 
@@ -223,6 +256,16 @@ def compare_records(
             "compiles reappeared)"
         )
         ok = False
+
+    # kernel path: informational, never a failure — but a value delta
+    # measured across a pallas-routing change is not apples-to-apples,
+    # so say which kernels produced each side (absent in old records)
+    b, c = baseline.get("kernel_path"), candidate.get("kernel_path")
+    if (b is not None or c is not None) and b != c:
+        lines.append(
+            f"  kernel_path: {json.dumps(b)} -> {json.dumps(c)} "
+            "(info: sides ran different kernel routings)"
+        )
 
     lines.append("PASS" if ok else "FAIL")
     return ok, lines

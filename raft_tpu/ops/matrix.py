@@ -186,6 +186,67 @@ def select_k(
     return vals, idx
 
 
+def select_k_stable(
+    scores: jax.Array,
+    k: int,
+    *,
+    select_min: bool = True,
+    input_indices: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Tie-stable k-selection over a (small) candidate pool.
+
+    Like :func:`select_k`, but equal scores are resolved by the *smallest
+    accompanying index* — a lexicographic ``(value, id)`` sort — instead of
+    by position in the row.  This is the property cross-partition merges
+    need: positional order in a concatenated candidate row depends on which
+    shard/tile contributed each candidate, so positional tie-breaking makes
+    the merged ids a function of the physical layout.  With id
+    tie-breaking, the same logical candidate set yields the same ids no
+    matter how it was partitioned.
+
+    Implementation is one full-width two-key ``lax.sort`` — intended for
+    merge widths (n_parts·k candidates), not for raw [batch, n] scans where
+    :func:`select_k`'s top_k/chunked paths are cheaper.
+
+    Note: for integer ``scores`` with ``select_min=False`` the key is
+    negated in int64, which is exact for int32 and below (int64 inputs at
+    INT64_MIN would overflow — unused by any caller).
+    """
+    squeeze = scores.ndim == 1
+    if squeeze:
+        scores = scores[None, :]
+        if input_indices is not None and input_indices.ndim == 1:
+            input_indices = input_indices[None, :]
+    n = scores.shape[-1]
+    if k > n:
+        raise ValueError(f"k={k} larger than row length {n}")
+    if input_indices is None:
+        ids = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32), scores.shape
+        )
+    else:
+        ids = input_indices.astype(jnp.int32)
+    # sentinel candidates (id −1, worst distance) must lose ties against
+    # real candidates: remap them past every real id for the sort key
+    sentinel = jnp.iinfo(jnp.int32).max
+    ids_key = jnp.where(ids < 0, jnp.int32(sentinel), ids)
+    if jnp.issubdtype(scores.dtype, jnp.integer):
+        key = scores.astype(jnp.int64)
+    else:
+        key = scores
+    if not select_min:
+        key = -key
+    skey, sids = lax.sort(
+        (key, ids_key), dimension=-1, num_keys=2, is_stable=False
+    )
+    skey, sids = skey[..., :k], sids[..., :k]
+    sids = jnp.where(sids == sentinel, jnp.int32(-1), sids)
+    vals = (-skey if not select_min else skey).astype(scores.dtype)
+    if squeeze:
+        return vals[0], sids[0]
+    return vals, sids
+
+
 def merge_topk(
     vals_a: jax.Array,
     idx_a: jax.Array,
@@ -198,10 +259,20 @@ def merge_topk(
     """Merge two per-row top-k result sets into one (ref:
     neighbors/detail/knn_merge_parts.cuh — the cross-tile merge used by tiled
     brute-force kNN). Concatenate-then-select is optimal on TPU since top_k
-    is sort-based."""
+    is sort-based.
+
+    Ordering guarantee: the merged rows are sorted by value (ascending for
+    ``select_min``, descending otherwise) and **ties are resolved by the
+    smallest id**, not by which input part contributed the candidate.  The
+    result is therefore a deterministic function of the logical candidate
+    *set*: merging the same candidates partitioned differently (a vs b
+    swapped, different shard boundaries in a cross-shard gather) yields
+    identical (values, ids).  Sentinel candidates (id −1 at the worst
+    distance) sort last and only surface when the pool underfills ``k``.
+    """
     vals = jnp.concatenate([vals_a, vals_b], axis=-1)
     idx = jnp.concatenate([idx_a, idx_b], axis=-1)
-    return select_k(vals, k, select_min=select_min, input_indices=idx)
+    return select_k_stable(vals, k, select_min=select_min, input_indices=idx)
 
 
 def argmax(m: jax.Array) -> jax.Array:

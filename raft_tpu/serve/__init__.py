@@ -18,6 +18,10 @@ indexes that change while being served.  Five pieces:
   reports into the process-wide :mod:`raft_tpu.obs` registry.
 - :mod:`~raft_tpu.serve.replica` — query-sharded multi-chip dispatch over
   a replicated index (comms/ mesh).
+- :mod:`~raft_tpu.serve.shard` — ``ShardedIndex``: the index itself
+  partitioned across the mesh axis (brute-force rows / IVF lists), each
+  shard running the existing local search with one cross-shard tie-stable
+  ``select_k`` merge — capacity ≈ N× one chip instead of throughput ≈ N×.
 
 ``SearchService`` (:mod:`~raft_tpu.serve.service`) assembles them, and
 carries the obs v2 hooks: attach a :class:`raft_tpu.obs.QualityAuditor`
@@ -41,6 +45,7 @@ from raft_tpu.serve.replica import (
     replicated_search,
 )
 from raft_tpu.serve.service import SearchService
+from raft_tpu.serve.shard import ShardedIndex, shard_index
 
 __all__ = [
     "IndexRegistry",
@@ -49,8 +54,10 @@ __all__ = [
     "ReplicaGroup",
     "SearchService",
     "ServingMetrics",
+    "ShardedIndex",
     "compile_count",
     "install_compile_listener",
     "make_replicated_search",
     "replicated_search",
+    "shard_index",
 ]

@@ -52,10 +52,13 @@ class IndexRegistry:
         auto-increments (unless given) and readers see either the old or
         the new index, never a mix.
         """
-        if not isinstance(index, MutableIndex):
+        from raft_tpu.serve.shard import ShardedIndex
+
+        if not isinstance(index, (MutableIndex, ShardedIndex)):
             raise TypeError(
-                f"registry holds MutableIndex, got {type(index)!r}; wrap "
-                "built indexes with MutableIndex(index)"
+                f"registry holds MutableIndex or ShardedIndex, got "
+                f"{type(index)!r}; wrap built indexes with "
+                "MutableIndex(index) or ShardedIndex.from_index(index)"
             )
         with self._lock:
             if version is None:

@@ -89,14 +89,35 @@ def make_replicated_search(comms: Comms, search_fn):
             sharded[k] = f
         return f
 
+    query_spec = NamedSharding(mesh, P(axis, None))
+
+    def _pre_sharded(queries) -> bool:
+        # the batcher's staging buffers (or a caller that device_put its
+        # own shards) may hand us queries already laid out P(axis, None);
+        # a fresh device_put then shows up as a pointless copy_out/shard
+        # stage in the flight recorder — detect and skip it
+        if not isinstance(queries, jax.Array) or queries.ndim != 2:
+            return False
+        if queries.dtype != jnp.float32 or queries.shape[0] % size != 0:
+            return False
+        try:
+            return queries.sharding.is_equivalent_to(query_spec, queries.ndim)
+        except Exception:
+            return False
+
     def run(queries, k: int) -> Tuple[jax.Array, jax.Array]:
-        queries = jnp.asarray(queries, jnp.float32)
-        q = queries.shape[0]
-        q_pad = -(-q // size) * size
-        if q_pad != q:
-            queries = jnp.pad(queries, ((0, q_pad - q), (0, 0)))
-        t0 = time.perf_counter()
-        qs = jax.device_put(queries, NamedSharding(mesh, P(axis, None)))
+        if _pre_sharded(queries):
+            q = queries.shape[0]
+            t0 = time.perf_counter()
+            qs = queries
+        else:
+            queries = jnp.asarray(queries, jnp.float32)
+            q = queries.shape[0]
+            q_pad = -(-q // size) * size
+            if q_pad != q:
+                queries = jnp.pad(queries, ((0, q_pad - q), (0, 0)))
+            t0 = time.perf_counter()
+            qs = jax.device_put(queries, query_spec)
         with trace_range("serve.replicated_search") as sp:
             t1 = time.perf_counter()
             v, i = _sharded(k)(qs)
@@ -134,6 +155,18 @@ class ReplicaGroup:
     the next batch) and runs the resolved index's merged mutable search
     replicated over the comms axis.  Drop-in as a batcher ``search_fn``
     via :meth:`searcher`.
+
+    Two scaling modes share this front end:
+
+    - ``shard_index=False`` (default): query sharding — every device holds
+      the full index, queries split ``P(axis, None)``.  N devices ≈ N×
+      throughput; capacity capped by one chip's HBM.
+    - ``shard_index=True``: index sharding — registry indexes are
+      partitioned across the axis via
+      :class:`~raft_tpu.serve.shard.ShardedIndex` (capacity ≈ N× one
+      chip), queries replicate, and one cross-shard merge produces the
+      global top-k.  An index that is *already* a ``ShardedIndex`` is
+      dispatched directly in either mode.
     """
 
     def __init__(
@@ -142,9 +175,11 @@ class ReplicaGroup:
         comms: Optional[Comms] = None,
         *,
         n_devices: Optional[int] = None,
+        shard_index: bool = False,
     ):
         self.registry = registry
         self.comms = comms if comms is not None else local_comms(n_devices)
+        self.shard_index = shard_index
         # per-name replicated searcher, keyed on (version, generation) so
         # hot-swaps and mutations retrace while steady-state traffic reuses
         # the warmed executables (zero hot-path recompiles)
@@ -157,13 +192,24 @@ class ReplicaGroup:
     def search(
         self, name: str, queries, k: int
     ) -> Tuple[jax.Array, jax.Array]:
+        from raft_tpu.serve.shard import ShardedIndex
+
         index, version = self.registry.get_versioned(name)
         key = (version, getattr(index, "generation", 0))
         cached = self._searchers.get(name)
         if cached is None or cached[0] != key:
-            run = make_replicated_search(
-                self.comms, lambda q_shard, kk: index.search(q_shard, kk)
-            )
+            if isinstance(index, ShardedIndex):
+                # already partitioned (and pinned to its own mesh) — the
+                # cross-shard merge is baked into its search
+                run = index.search
+            elif self.shard_index:
+                run = ShardedIndex.from_index(
+                    index, self.comms, label=name
+                ).search
+            else:
+                run = make_replicated_search(
+                    self.comms, lambda q_shard, kk: index.search(q_shard, kk)
+                )
             self._searchers[name] = cached = (key, run)
             # every rebuild retraces the replicated executables on next
             # dispatch — a counter climbing on the hot path is the

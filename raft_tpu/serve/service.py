@@ -43,6 +43,7 @@ from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
 from raft_tpu.serve.registry import IndexRegistry
 from raft_tpu.serve.replica import ReplicaGroup
+from raft_tpu.serve.shard import ShardedIndex
 
 
 class SearchService:
@@ -88,12 +89,14 @@ class SearchService:
     ) -> int:
         """Register ``index`` under ``name`` and start its batcher.
 
-        ``index`` may be a raw built index (wrapped automatically) or a
-        :class:`MutableIndex`.  With ``warmup`` the whole bucket ladder is
-        compiled before the method returns, so the first real query is
-        already on the hot path.
+        ``index`` may be a raw built index (wrapped automatically), a
+        :class:`MutableIndex`, or a
+        :class:`~raft_tpu.serve.shard.ShardedIndex` (served as-is — the
+        cross-shard dispatch is baked into its ``search``).  With
+        ``warmup`` the whole bucket ladder is compiled before the method
+        returns, so the first real query is already on the hot path.
         """
-        if not isinstance(index, MutableIndex):
+        if not isinstance(index, (MutableIndex, ShardedIndex)):
             index = MutableIndex(index)
         version = self.registry.register(name, index)
         k = self.k if k is None else int(k)
@@ -163,8 +166,10 @@ class SearchService:
 
         The existing batcher (and its warmed executables) is kept: a
         same-shaped replacement serves its next batch with no recompile.
+        A :class:`~raft_tpu.serve.shard.ShardedIndex` swaps in unwrapped —
+        replicated → sharded layout changes are atomic the same way.
         """
-        if not isinstance(index, MutableIndex):
+        if not isinstance(index, (MutableIndex, ShardedIndex)):
             index = MutableIndex(index)
         with self._lock:
             if name not in self._batchers:

@@ -1,0 +1,593 @@
+"""Pod-scale index sharding: partition one index across a named mesh axis.
+
+``ReplicaGroup`` scales *throughput* by replicating the whole index and
+sharding queries; capacity stays capped by a single chip's HBM.  This
+module scales *capacity*: :class:`ShardedIndex` partitions the index
+itself — brute-force rows, IVF lists (ivf_flat + ivf_pq; CAGRA falls back
+to row-partitioned brute refine over its dataset) — across the devices of
+a :class:`~raft_tpu.comms.comms.Comms` mesh axis via ``NamedSharding``,
+so each device holds ~1/N of the index.
+
+Search is the blocking scheme of "Large Scale Distributed Linear Algebra
+With TPUs" (PAPERS.md) applied to ANN: every shard runs the *existing*
+local search (the same dispatch the single-device path uses, including
+the Pallas IVF scan legs) over its partition under ``shard_map``, then the
+global answer is produced by one cross-shard merge — an all-gather of the
+per-shard top-k candidates followed by a single tie-stable
+:func:`~raft_tpu.ops.matrix.select_k_stable`.  The merge collective moves
+``n_shards · k`` candidates per query (tiny next to the index), and an
+optional bf16 cast on the gathered distances (EQuARX-style,
+``RAFT_TPU_SHARD_MERGE_DTYPE=bfloat16``) halves even that — candidate
+distances tolerate low precision before any final refine.
+
+Semantics vs the single-device backends:
+
+- ``brute_force`` / ``cagra`` fallback: exact — the per-shard candidate
+  union always contains the global top-k, and the id-tie-stable merge
+  returns identical (ids, distances).
+- ``ivf_flat`` / ``ivf_pq``: each shard probes up to ``n_probes`` of *its
+  own* lists, so the probed set is a superset of the single-device probed
+  set — recall is ≥ the unsharded search at equal ``n_probes`` (exactly
+  equal when probing is exhaustive, ``n_probes >= n_lists``).  This
+  mirrors how multi-GPU IVF deployments shard (per-partition probing).
+
+Tombstones from a :class:`~raft_tpu.serve.mutation.MutableIndex` are
+folded in at shard time (the global pass bitset is tiny and rides along
+replicated); live side-buffer rows are rejected — compact/rebuild before
+sharding.  A sharded index is an immutable serving layout: mutate the
+source and hot-swap a fresh :meth:`ShardedIndex.from_index` through the
+registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu import obs
+from raft_tpu.comms.comms import Comms, local_comms
+from raft_tpu.core.bitset import Bitset, WORD_BITS
+from raft_tpu.core.compat import shard_map
+from raft_tpu.core.trace import trace_range
+from raft_tpu.distance.pairwise import DISTANCE_TYPES
+from raft_tpu.ops import matrix
+from raft_tpu.serve.mutation import MutableIndex
+
+#: env knob for the merge all-gather's distance dtype (EQuARX-style
+#: quantized collective): "float32" (default, exact) or "bfloat16"
+MERGE_DTYPE_ENV = "RAFT_TPU_SHARD_MERGE_DTYPE"
+
+_MERGE_DTYPES = {
+    "float32": None,  # no cast — gather full-precision distances
+    "f32": None,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+}
+
+
+def merge_dtype_from_env() -> Optional[jnp.dtype]:
+    """Resolve ``RAFT_TPU_SHARD_MERGE_DTYPE`` to a cast dtype (or None)."""
+    name = os.environ.get(MERGE_DTYPE_ENV, "float32").strip().lower()
+    if name not in _MERGE_DTYPES:
+        raise ValueError(
+            f"{MERGE_DTYPE_ENV}={name!r} not understood; expected one of "
+            f"{sorted(_MERGE_DTYPES)}"
+        )
+    return _MERGE_DTYPES[name]
+
+
+def _pack_pass_words(mask: np.ndarray) -> np.ndarray:
+    """Pack a boolean pass mask into Bitset-layout uint32 words (host)."""
+    n = mask.shape[0]
+    nw = (n + WORD_BITS - 1) // WORD_BITS
+    padded = np.zeros((nw * WORD_BITS,), np.uint32)
+    padded[:n] = mask.astype(np.uint32)
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)
+    return np.sum(
+        padded.reshape(nw, WORD_BITS) << shifts[None, :], axis=1, dtype=np.uint32
+    )
+
+
+def _round_robin(n_items: int, n_shards: int) -> list:
+    """Per-shard item indices, round-robin (balances size-sorted skew)."""
+    return [np.arange(s, n_items, n_shards) for s in range(n_shards)]
+
+
+class ShardedIndex:
+    """One logical index partitioned across a mesh axis.
+
+    Build via :meth:`from_index`; call :meth:`search` like any backend.
+    Quacks enough like :class:`MutableIndex` (``kind``/``dim``/``size``/
+    ``generation``/``pending_mutations``/``device_bytes``/``search``) to be
+    registered and hot-swapped through ``IndexRegistry``/``SearchService``
+    and served by ``ReplicaGroup``/``MicroBatcher``.
+    """
+
+    def __init__(
+        self,
+        comms: Comms,
+        kind: str,
+        metric: str,
+        dim: int,
+        size: int,
+        parts: Dict[str, jax.Array],
+        specs: Dict[str, P],
+        *,
+        search_params=None,
+        merge_dtype=None,
+        label: str = "",
+        shard_stats: Optional[Dict[str, list]] = None,
+    ):
+        self.comms = comms
+        self.kind = kind
+        self.metric = metric
+        self.dim = int(dim)
+        self.size = int(size)
+        self.search_params = search_params
+        self.label = label or kind
+        self.merge_dtype = merge_dtype
+        canonical = DISTANCE_TYPES[metric]
+        self.select_min = canonical != "inner_product"
+        self._names = tuple(parts)
+        self._parts = parts
+        self._specs = specs
+        self._searchers: Dict[Tuple[int, ...], object] = {}
+        # MutableIndex-compatible serving surface: a sharded layout is
+        # immutable — mutate the source index and hot-swap a re-shard
+        self.generation = 0
+        self._shard_stats = shard_stats or {}
+        self._publish_shard_gauges()
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_index(
+        cls,
+        index,
+        comms: Optional[Comms] = None,
+        *,
+        n_devices: Optional[int] = None,
+        search_params=None,
+        merge_dtype="env",
+        label: str = "",
+    ) -> "ShardedIndex":
+        """Partition a built index (or a compacted ``MutableIndex``) across
+        ``comms``'s axis.
+
+        ``merge_dtype`` defaults to the ``RAFT_TPU_SHARD_MERGE_DTYPE`` env
+        knob; pass ``None`` (exact f32 merge) or ``jnp.bfloat16`` to
+        override.  A ``MutableIndex`` may carry tombstones (folded into the
+        sharded filter) but not live side-buffer rows.
+        """
+        comms = comms if comms is not None else local_comms(n_devices)
+        if merge_dtype == "env":
+            merge_dtype = merge_dtype_from_env()
+        deleted = None
+        if isinstance(index, MutableIndex):
+            with index._lock:
+                if int(index._side_live.sum()) > 0:
+                    raise ValueError(
+                        "cannot shard a MutableIndex with live side-buffer "
+                        "rows; rebuild/compact the index first"
+                    )
+                if index._n_deleted:
+                    deleted = index._deleted.copy()
+            if search_params is None:
+                search_params = index.search_params
+            kind, inner = index.kind, index.index
+        else:
+            kind, inner = _infer_kind(index), index
+        if kind in ("brute_force", "cagra"):
+            # CAGRA's graph is a per-shard traversal structure with global
+            # fan-out; the capacity win comes from sharding the rows, so the
+            # fallback is row-partitioned brute refine over its dataset
+            return cls._shard_rows(
+                comms, kind, inner, deleted, merge_dtype, label
+            )
+        if kind == "ivf_flat":
+            return cls._shard_ivf_flat(
+                comms, inner, deleted, search_params, merge_dtype, label
+            )
+        if kind == "ivf_pq":
+            return cls._shard_ivf_pq(
+                comms, inner, deleted, search_params, merge_dtype, label
+            )
+        raise ValueError(f"unsupported index kind for sharding: {kind!r}")
+
+    @classmethod
+    def _shard_rows(cls, comms, kind, inner, deleted, merge_dtype, label):
+        data = np.asarray(inner.dataset)
+        n, d = data.shape
+        s_count = comms.get_size()
+        r = -(-n // s_count)
+        rows = np.zeros((s_count, r, d), data.dtype)
+        ids = np.full((s_count, r), -1, np.int32)
+        words = np.zeros(
+            (s_count, (r + WORD_BITS - 1) // WORD_BITS), np.uint32
+        )
+        row_counts = []
+        for s in range(s_count):
+            lo, hi = s * r, min((s + 1) * r, n)
+            m = hi - lo
+            if m > 0:
+                rows[s, :m] = data[lo:hi]
+                ids[s, :m] = np.arange(lo, hi, dtype=np.int32)
+            passes = np.zeros((r,), bool)
+            passes[:m] = True
+            if deleted is not None and m > 0:
+                passes[:m] &= ~deleted[lo:hi]
+            words[s] = _pack_pass_words(passes)
+            row_counts.append(int(passes.sum()))
+        parts, specs = _place(
+            comms,
+            sharded={"rows": rows, "ids": ids, "pass_words": words},
+            replicated={},
+        )
+        live = n if deleted is None else n - int(deleted.sum())
+        return cls(
+            comms, kind, inner.metric, d, live, parts, specs,
+            merge_dtype=merge_dtype, label=label,
+            shard_stats={"rows": row_counts},
+        )
+
+    @classmethod
+    def _shard_ivf_flat(cls, comms, inner, deleted, params, merge_dtype, label):
+        from raft_tpu.neighbors import ivf_flat
+
+        params = params if params is not None else ivf_flat.SearchParams()
+        arrays = {
+            "centers": np.asarray(inner.centers),
+            "list_data": np.asarray(inner.list_data),
+            "list_index": np.asarray(inner.list_index),
+            "list_sizes": np.asarray(inner.list_sizes),
+            "list_norms": np.asarray(inner.list_norms),
+        }
+        fills = {"list_index": -1, "list_sizes": 0, "list_norms": np.inf}
+        sharded, stats = _partition_lists(arrays, fills, comms.get_size())
+        n_main = int(arrays["list_sizes"].sum())
+        replicated = _global_pass_filter(deleted, n_main)
+        parts, specs = _place(comms, sharded=sharded, replicated=replicated)
+        live = n_main if deleted is None else n_main - int(deleted.sum())
+        return cls(
+            comms, "ivf_flat", inner.metric, int(inner.dim), live, parts,
+            specs, search_params=params, merge_dtype=merge_dtype, label=label,
+            shard_stats=stats,
+        )
+
+    @classmethod
+    def _shard_ivf_pq(cls, comms, inner, deleted, params, merge_dtype, label):
+        from raft_tpu.neighbors import ivf_pq
+
+        params = params if params is not None else ivf_pq.SearchParams()
+        arrays = {
+            "centers": np.asarray(inner.centers),
+            "centers_rot": np.asarray(inner.centers_rot),
+            "list_codes": np.asarray(inner.list_codes),
+            "list_index": np.asarray(inner.list_index),
+            "list_sizes": np.asarray(inner.list_sizes),
+            "list_data": np.asarray(inner.list_data),
+            "list_y2": np.asarray(inner.list_y2),
+        }
+        fills = {"list_index": -1, "list_sizes": 0, "list_y2": np.inf}
+        replicated = {"rotation": np.asarray(inner.rotation)}
+        if inner.codebook_kind == "per_cluster":
+            arrays["codebook"] = np.asarray(inner.codebook)
+        else:
+            replicated["codebook"] = np.asarray(inner.codebook)
+        sharded, stats = _partition_lists(arrays, fills, comms.get_size())
+        n_main = int(arrays["list_sizes"].sum())
+        replicated.update(_global_pass_filter(deleted, n_main))
+        parts, specs = _place(comms, sharded=sharded, replicated=replicated)
+        live = n_main if deleted is None else n_main - int(deleted.sum())
+        self = cls(
+            comms, "ivf_pq", inner.metric, int(inner.dim), live, parts,
+            specs, search_params=params, merge_dtype=merge_dtype, label=label,
+            shard_stats=stats,
+        )
+        self._pq_meta = (
+            inner.codebook_kind, int(inner.pq_bits), float(inner.scan_scale),
+        )
+        return self
+
+    # -- search --------------------------------------------------------------
+    def search(self, queries, k: int) -> Tuple[jax.Array, jax.Array]:
+        """Global (distances [q, k], ids [q, k]) over all shards.
+
+        One SPMD dispatch: per-shard local search + the single cross-shard
+        merge collective.  Executables are cached per k (and per query
+        batch shape via jit), preserving the batcher's zero-recompile
+        contract once the bucket ladder is warm.
+        """
+        queries = jnp.asarray(queries, jnp.float32)
+        if queries.ndim != 2 or queries.shape[1] != self.dim:
+            raise ValueError(
+                f"queries shape {queries.shape} vs index dim {self.dim}"
+            )
+        f = self._searcher(int(k))
+        t0 = time.perf_counter()
+        with trace_range("serve.sharded_search") as sp:
+            v, i = f(queries, *(self._parts[n] for n in self._names))
+            dt = time.perf_counter() - t0
+            if sp is not None:
+                # dispatch: tracing/enqueue of the sharded executable (the
+                # device wait lands in the caller's block_until_ready)
+                sp.add_stage("dispatch", dt)
+        obs.default_registry().histogram(
+            "raft_tpu_sharded_search_seconds",
+            help="host-side dispatch latency of index-sharded searches "
+            "(the slowest shard paces the whole SPMD step)",
+        ).observe(dt, index=self.label, shards=str(self.n_shards))
+        return v, i
+
+    @property
+    def n_shards(self) -> int:
+        return self.comms.get_size()
+
+    def _searcher(self, k: int):
+        f = self._searchers.get(k)
+        if f is None:
+            f = self._build_searcher(k)
+            self._searchers[k] = f
+        return f
+
+    def _local_pool(self) -> Tuple[int, int]:
+        """(n_probes_local, candidate pool per shard) from static shapes."""
+        if self.kind in ("brute_force", "cagra"):
+            return 0, int(self._parts["rows"].shape[1])
+        l_local = int(self._parts["list_index"].shape[1])
+        cap = int(self._parts["list_index"].shape[2])
+        npb = min(int(self.search_params.n_probes), l_local)
+        return npb, npb * cap
+
+    def _build_searcher(self, k: int):
+        mesh, axis = self.comms.mesh, self.comms.axis
+        npb, pool = self._local_pool()
+        kk = min(k, pool)
+        if kk * self.n_shards < k:
+            raise ValueError(
+                f"k={k} exceeds the sharded candidate pool "
+                f"{self.n_shards}x{kk}; raise n_probes or lower k"
+            )
+        local = self._make_local(k, kk, npb)
+        in_specs = (P(None, None),) + tuple(
+            self._specs[n] for n in self._names
+        )
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=(P(None, None), P(None, None)),
+                check_vma=False,
+            )
+        )
+
+    def _make_local(self, k: int, kk: int, npb: int):
+        # the per-shard search and the merge selection both run under
+        # nested jit, not bare in the shard_map body: older jax's
+        # ShardMapTracer lacks the eager operator surface, while
+        # nested-jit tracers are complete (same split as replica.py) —
+        # only the all-gather collectives live in the bare body
+        core = jax.jit(self._make_shard_search(kk, npb))
+        select_min = self.select_min
+
+        def _select(vg, ig):
+            # ONE cross-shard selection; ties resolve to the smallest
+            # global id regardless of shard layout (select_k_stable)
+            return matrix.select_k_stable(
+                vg.astype(jnp.float32), k,
+                select_min=select_min, input_indices=ig,
+            )
+
+        sel = jax.jit(_select)
+
+        def local(q, *args):
+            v, gi = core(q, *args)
+            vg = self.comms.allgather(v, axis=1)
+            ig = self.comms.allgather(gi, axis=1)
+            return sel(vg, ig)
+
+        return local
+
+    def _make_shard_search(self, kk: int, npb: int):
+        """Per-shard ``(queries, *parts) -> (dists [q,kk], global ids)``,
+        squeezing the leading shard axis off every partitioned block and
+        re-assembling the backend Index so the *existing* local search
+        (Pallas scan legs included) runs unchanged over the partition.
+        The optional EQuARX-style bf16 cast of the candidate distances
+        happens here, before the merge all-gather moves them."""
+        names = self._names
+        merge_dtype = self.merge_dtype
+
+        def _cast(v):
+            if merge_dtype is not None and v.dtype != merge_dtype:
+                return v.astype(merge_dtype)
+            return v
+
+        if self.kind in ("brute_force", "cagra"):
+            from raft_tpu.neighbors import brute_force
+
+            def core(q, *args):
+                p = dict(zip(names, args))
+                rows, ids = p["rows"][0], p["ids"][0]
+                filt = Bitset(p["pass_words"][0], rows.shape[0])
+                v, li = brute_force.knn(
+                    rows, q, kk, metric=self.metric, sample_filter=filt
+                )
+                safe = jnp.clip(li, 0, rows.shape[0] - 1)
+                gi = jnp.where(li >= 0, ids[safe], jnp.int32(-1))
+                return _cast(v), gi
+
+            return core
+
+        if self.kind == "ivf_flat":
+            from raft_tpu.neighbors import ivf_flat
+
+            sp = dataclasses.replace(self.search_params, n_probes=npb)
+
+            def core(q, *args):
+                p = dict(zip(names, args))
+                sub = ivf_flat.Index(
+                    self.metric, p["centers"][0], p["list_data"][0],
+                    p["list_index"][0], p["list_sizes"][0], p["list_norms"][0],
+                )
+                filt = _replicated_filter(p)
+                v, gi = ivf_flat.search(sp, sub, q, kk, sample_filter=filt)
+                return _cast(v), gi
+
+            return core
+
+        from raft_tpu.neighbors import ivf_pq
+
+        codebook_kind, pq_bits, scan_scale = self._pq_meta
+        sp = dataclasses.replace(self.search_params, n_probes=npb)
+
+        def core(q, *args):
+            p = dict(zip(names, args))
+            codebook = (
+                p["codebook"][0] if codebook_kind == "per_cluster"
+                else p["codebook"]
+            )
+            sub = ivf_pq.Index(
+                self.metric, codebook_kind, pq_bits, p["centers"][0],
+                p["centers_rot"][0], p["rotation"], codebook,
+                p["list_codes"][0], p["list_index"][0], p["list_sizes"][0],
+                p["list_data"][0], p["list_y2"][0], scan_scale=scan_scale,
+            )
+            filt = _replicated_filter(p)
+            v, gi = ivf_pq.search(sp, sub, q, kk, sample_filter=filt)
+            return _cast(v), gi
+
+        return core
+
+    # -- MutableIndex-compatible serving surface ----------------------------
+    def pending_mutations(self) -> Tuple[int, int]:
+        """(0, 0): a sharded layout is immutable; mutate the source index
+        and hot-swap a re-shard through the registry."""
+        return 0, 0
+
+    def device_bytes(self) -> int:
+        """Total bytes across all shards (feeds the per-version live-buffer
+        gauges, comparable with the unsharded index's footprint)."""
+        return sum(int(a.nbytes) for a in self._parts.values())
+
+    def per_shard_bytes(self) -> list:
+        """Bytes resident on each device: sharded arrays contribute 1/N,
+        replicated ones (rotation, shared codebook, filter) in full."""
+        s_count = self.n_shards
+        shard_b = repl_b = 0
+        for name, arr in self._parts.items():
+            if self._specs[name] and self._specs[name][0] is not None:
+                shard_b += int(arr.nbytes) // s_count
+            else:
+                repl_b += int(arr.nbytes)
+        return [shard_b + repl_b] * s_count
+
+    def save(self, path: str) -> None:
+        raise NotImplementedError(
+            "ShardedIndex is a serving-time layout; snapshot the source "
+            "index and re-shard on restore"
+        )
+
+    # -- observability -------------------------------------------------------
+    def _publish_shard_gauges(self) -> None:
+        """Per-shard row/list/byte gauges — the imbalance dashboard."""
+        reg = obs.default_registry()
+        per_bytes = self.per_shard_bytes()
+        rows = self._shard_stats.get("rows")
+        lists = self._shard_stats.get("lists")
+        for s in range(self.n_shards):
+            labels = {"index": self.label, "shard": str(s)}
+            if rows is not None:
+                reg.gauge(
+                    "raft_tpu_shard_rows",
+                    help="live vectors owned by each index shard",
+                ).set(float(rows[s]), **labels)
+            if lists is not None:
+                reg.gauge(
+                    "raft_tpu_shard_lists",
+                    help="IVF lists owned by each index shard",
+                ).set(float(lists[s]), **labels)
+            reg.gauge(
+                "raft_tpu_shard_live_bytes",
+                help="per-device bytes held by each index shard "
+                "(sharded arrays at 1/N + replicated sidecars)",
+            ).set(float(per_bytes[s]), **labels)
+
+
+def _infer_kind(index) -> str:
+    mod = type(index).__module__.rsplit(".", 1)[-1]
+    if mod not in ("brute_force", "ivf_flat", "ivf_pq", "cagra"):
+        raise ValueError(
+            f"cannot infer index kind from {type(index)!r}; pass a built "
+            "brute_force/ivf_flat/ivf_pq/cagra index or a MutableIndex"
+        )
+    return mod
+
+
+def _partition_lists(arrays, fills, s_count):
+    """Round-robin the leading (list) axis of every array into [S, Lp, ...]
+    stacks, padding with empty lists (sizes 0, ids −1, norms inf)."""
+    l_total = arrays["list_index"].shape[0]
+    groups = _round_robin(l_total, s_count)
+    lp = max(len(g) for g in groups)
+    out = {}
+    for name, arr in arrays.items():
+        fill = fills.get(name, 0)
+        stack = np.full((s_count, lp) + arr.shape[1:], fill, arr.dtype)
+        for s, g in enumerate(groups):
+            if len(g):
+                stack[s, : len(g)] = arr[g]
+                if name == "centers" and len(g) < lp:
+                    # padded slots re-use a real center: they may attract
+                    # probes (wasting one) but their lists are empty, so
+                    # every candidate they yield is (−1, worst) — harmless
+                    stack[s, len(g):] = arr[g[0]]
+        out[name] = stack
+    sizes = arrays["list_sizes"]
+    stats = {
+        "lists": [len(g) for g in groups],
+        "rows": [int(sizes[g].sum()) for g in groups],
+    }
+    return out, stats
+
+
+def _global_pass_filter(deleted, n_main):
+    """Replicated global-id pass bitset words (IVF ids are global)."""
+    if deleted is None:
+        return {}
+    return {"pass_words": _pack_pass_words(~deleted[:n_main])}
+
+
+def _replicated_filter(parts):
+    words = parts.get("pass_words")
+    if words is None:
+        return None
+    return Bitset(words, int(words.shape[0]) * WORD_BITS)
+
+
+def _place(comms, *, sharded, replicated):
+    """device_put every array with its NamedSharding: sharded stacks split
+    on the leading (shard) axis, sidecars replicated on every device."""
+    mesh, axis = comms.mesh, comms.axis
+    parts, specs = {}, {}
+    for name, arr in sharded.items():
+        spec = P(axis, *([None] * (arr.ndim - 1)))
+        parts[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        specs[name] = spec
+    for name, arr in replicated.items():
+        spec = P(*([None] * arr.ndim))
+        parts[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        specs[name] = spec
+    return parts, specs
+
+
+def shard_index(index, comms: Optional[Comms] = None, **kwargs) -> ShardedIndex:
+    """Convenience alias for :meth:`ShardedIndex.from_index`."""
+    return ShardedIndex.from_index(index, comms, **kwargs)

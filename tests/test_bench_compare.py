@@ -42,7 +42,40 @@ def test_record_round_trip(tmp_path):
     doc = json.load(open(path))
     assert doc["schema"] == "raft_tpu.bench"
     assert doc["schema_version"] == export.BENCH_SCHEMA_VERSION
-    assert export.load_record(path) == PAYLOAD
+    loaded = export.load_record(path)
+    # written records carry the kernel-path attribution stamp; a payload
+    # that didn't set one gets the env-derived default
+    assert loaded.pop("kernel_path") == {"pallas": False}
+    assert loaded == PAYLOAD
+
+
+def test_kernel_path_stamp_and_passthrough(monkeypatch):
+    stamped = export.bench_record(PAYLOAD)["record"]
+    assert stamped["kernel_path"] == {"pallas": False}
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    assert export.kernel_path() == {"pallas": True}
+    # a leg that measured its own routing wins over the env default
+    explicit = export.bench_record(
+        dict(PAYLOAD, kernel_path={"pallas": False})
+    )["record"]
+    assert explicit["kernel_path"] == {"pallas": False}
+    # metric/dtype form asks the shared pallas_scan_enabled gate
+    import jax.numpy as jnp
+
+    assert export.kernel_path("sqeuclidean", jnp.float32)["pallas"] is True
+    monkeypatch.delenv("RAFT_TPU_PALLAS")
+    assert export.kernel_path("sqeuclidean", jnp.float32)["pallas"] is False
+
+
+def test_kernel_path_change_is_informational_not_regression():
+    base = dict(PAYLOAD, kernel_path={"pallas": False})
+    cand = dict(PAYLOAD, kernel_path={"pallas": True})
+    ok, lines = export.compare_records(base, cand)
+    assert ok, lines
+    assert any("kernel_path" in ln and "info" in ln for ln in lines)
+    # old records without the field stay silent
+    ok, lines = export.compare_records(PAYLOAD, PAYLOAD)
+    assert ok and not any("kernel_path" in ln for ln in lines)
 
 
 def test_load_bare_payload(tmp_path):
@@ -259,6 +292,45 @@ def test_serve_pipeline_smoke_against_frozen_record(tmp_path):
     baseline = os.path.join(
         REPO, "benchmarks", "BENCH_serve_pipeline_r06.json"
     )
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_shard_index_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the index-sharding A/B: run ``bench.py shard`` (single
+    vs query-replicated vs index-sharded over 8 forced host devices) and
+    gate it with ``bench.py compare`` against the frozen record.  The run
+    must show the capacity win (per-device bytes shrinking ~Nx), identical
+    ids across arms at exhaustive probing, and zero hot-path recompiles."""
+    candidate = str(tmp_path / "shard_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "shard"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["devices"] == 8
+    assert line["bytes_shrink_x"] >= line["devices"] / 2, (
+        f"per-device memory only shrank {line['bytes_shrink_x']}x"
+    )
+    assert line["recall"] >= 0.999
+    assert line["recompiles"] == 0, "shard leg recompiled on the hot path"
+    arms = line["arms"]
+    assert arms["sharded"]["per_device_bytes"] < (
+        arms["replicated"]["per_device_bytes"] / 4
+    )
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_shard_r08.json")
     cmp_out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "compare",
          "--baseline", baseline, "--candidate", candidate],

@@ -93,6 +93,59 @@ def test_merge_topk(rng):
     np.testing.assert_allclose(np.asarray(v), np.sort(full, axis=1)[:, :10], rtol=1e-6)
 
 
+def test_merge_topk_tie_stability_partition_invariance(rng):
+    # the cross-shard merge guarantee: with tied values, the winner is the
+    # smallest id, and the merged result is a function of the candidate
+    # SET — any partition of the pool into (a, b) parts merges identically
+    vals = np.repeat(rng.random((1, 8)).astype(np.float32), 2, axis=0)
+    vals = np.round(vals, 1)  # force tie collisions
+    ids = np.arange(16, dtype=np.int32).reshape(2, 8)
+    ids[1] = ids[1][::-1] - 8  # same pool, different id order
+    ref_v, ref_i = None, None
+    for split in (1, 3, 4, 7):
+        v, i = matrix.merge_topk(
+            vals[:, :split], ids[:, :split], vals[:, split:], ids[:, split:], 5
+        )
+        if ref_v is None:
+            ref_v, ref_i = np.asarray(v), np.asarray(i)
+        else:
+            np.testing.assert_array_equal(np.asarray(v), ref_v)
+            np.testing.assert_array_equal(np.asarray(i), ref_i)
+    # within a row, equal values must carry ascending ids
+    for r in range(2):
+        for c in range(4):
+            if ref_v[r, c] == ref_v[r, c + 1]:
+                assert ref_i[r, c] < ref_i[r, c + 1]
+
+
+def test_merge_topk_sentinels_lose_ties(rng):
+    # a padded shard contributes (id −1, worst distance); a real candidate
+    # at that same worst distance must still win the slot
+    va = np.array([[0.5, np.inf]], np.float32)
+    ia = np.array([[3, -1]], np.int32)
+    vb = np.array([[np.inf, np.inf]], np.float32)
+    ib = np.array([[7, -1]], np.int32)
+    v, i = matrix.merge_topk(va, ia, vb, ib, 3)
+    np.testing.assert_array_equal(np.asarray(i), [[3, 7, -1]])
+    # select_max orientation: worst is -inf, same rule
+    v, i = matrix.merge_topk(
+        -va, ia, -vb, ib, 3, select_min=False
+    )
+    np.testing.assert_array_equal(np.asarray(i), [[3, 7, -1]])
+
+
+def test_select_k_stable_smallest_id_wins(rng):
+    scores = np.array([[2.0, 1.0, 2.0, 1.0]], np.float32)
+    ids = np.array([[9, 4, 1, 2]], np.int32)
+    vals, out = matrix.select_k_stable(scores, 4, input_indices=ids)
+    np.testing.assert_array_equal(np.asarray(out), [[2, 4, 1, 9]])
+    with pytest.raises(ValueError):
+        matrix.select_k_stable(scores, 5)
+    # 1-D convenience + default indices
+    vals, out = matrix.select_k_stable(np.array([3.0, 1.0, 1.0], np.float32), 2)
+    np.testing.assert_array_equal(np.asarray(out), [1, 2])
+
+
 def test_argmax_argmin_gather(rng):
     m = rng.random((10, 20)).astype(np.float32)
     np.testing.assert_array_equal(np.asarray(matrix.argmax(m)), m.argmax(1))
