@@ -170,6 +170,9 @@ def main() -> None:
     if "shard" in sys.argv[1:]:
         run_shard_leg()
         return
+    if "compact" in sys.argv[1:]:
+        run_compact_leg()
+        return
     if "obs" in sys.argv[1:]:
         run_obs_leg()
         return
@@ -846,6 +849,166 @@ def run_flight_leg() -> None:
             "recompiles": on["recompiles"] + off["recompiles"],
             "requests": n_requests,
             "n": n,
+        }
+    )
+
+
+def run_compact_leg() -> None:
+    """``python bench.py compact`` — online-compaction churn-soak A/B (CPU).
+
+    Two arms run the identical upsert/delete/search churn (same rng
+    stream) against a served brute-force index:
+
+    - ``off``: no compactor — the side buffer and tombstones accrete, so
+      side rows must grow monotonically (the failure mode the subsystem
+      exists to remove);
+    - ``on``: the compactor folds mutations back into the main structure
+      whenever the side buffer crosses the trigger, so side rows and
+      live index bytes stay bounded across every hot-swap.
+
+    The headline value is the on-arm search QPS over the whole soak.  The
+    line is garbage unless: on-arm max side rows stay within one trigger
+    window, on-arm live bytes stay flat at the first compacted footprint,
+    on-arm recall >= off-arm recall (both exact here, so equality), every
+    promoted pass kept its projected peak under the memory budget, and
+    on-arm hot-path recompiles read 0 after warmup — all asserted before
+    emitting.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu import serve
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.obs import slowlog
+    from raft_tpu.serve.compactor import CompactionPolicy, Compactor
+    from raft_tpu.stats import recall_at_k
+
+    n, d, k = 3800, 32, 10
+    cycles, churn_rows = 24, 128
+    n_q = 64
+    pol = CompactionPolicy(
+        max_side_rows=256, max_tombstone_frac=0.25,
+        interval_s=3600.0,           # deterministic: scan() driven per cycle
+        chunk_rows=4096, gate_queries=64,
+    )
+    slowlog.configure(None)  # compaction passes outlast the slow threshold
+    rng0 = np.random.default_rng(0)
+    dataset = rng0.random((n, d), dtype=np.float32)
+    queries = rng0.random((n_q, d), dtype=np.float32)
+
+    def run_arm(compact: bool) -> dict:
+        rng = np.random.default_rng(7)
+        svc = serve.SearchService(k=k, max_batch=n_q, max_delay_ms=0.5,
+                                  compaction=False)
+        comp = Compactor(svc, pol, start=False) if compact else None
+        svc.compactor = comp
+        mi = serve.MutableIndex(brute_force.build(dataset))
+        svc.add_index("churn", mi, warmup=True)
+        live = {int(i): dataset[i] for i in range(n)}
+
+        def churn():
+            cur = svc.get("churn")
+            rows = rng.random((churn_rows, d), dtype=np.float32)
+            ids = [int(i) for i in cur.upsert(rows)]
+            # oldest-first deletes: the off arm's deletes then always hit
+            # main rows, so its side buffer growth is pure and monotone
+            dead = sorted(live)[:churn_rows]
+            cur.delete(dead)
+            for i in dead:
+                del live[i]
+            for i, r in zip(ids, rows):
+                live[i] = r
+            return ids
+
+        # warm phase (not measured): first churn establishes the mutation
+        # variants; with the compactor on, the first pass also moves the
+        # index to its pow2-padded steady-state shapes and warms them
+        churn()
+        if comp is not None:
+            first = comp.trigger_now("churn")
+            assert first["status"] == "promoted", first
+        jax.block_until_ready(svc.search("churn", queries))
+        svc._batcher("churn").metrics.reset_hot_path()
+
+        side_series, bytes_series, lat = [], [], []
+        base_bytes = svc.get("churn").device_bytes()
+        for _cycle in range(cycles):
+            churn()
+            t0 = time.perf_counter()
+            for _ in range(4):
+                jax.block_until_ready(svc.search("churn", queries))
+            lat.append((time.perf_counter() - t0) / 4)
+            if comp is not None:
+                comp.scan()
+            _deletes, side = svc.get("churn").pending_mutations()
+            side_series.append(side)
+            bytes_series.append(svc.get("churn").device_bytes())
+
+        # exact oracle over the tracked live set scores the final state
+        ids_live = np.fromiter(live.keys(), np.int64, len(live))
+        rows_live = np.stack([live[int(i)] for i in ids_live])
+        _dd, oracle_rows = brute_force.knn(rows_live, queries, k)
+        oracle = ids_live[np.asarray(oracle_rows)]
+        _dd, got = svc.search("churn", queries)
+        recall = float(recall_at_k(np.asarray(got), oracle))
+
+        st = svc.stats("churn")
+        snap = comp.snapshot() if comp is not None else {}
+        last = snap.get("last_result") or {}
+        if comp is not None:
+            comp.stop()
+        svc.stop()
+        total_q = cycles * 4 * n_q
+        return {
+            "qps": round(total_q / sum(lat), 1),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3 / n_q, 3),
+            "recall": round(recall, 4),
+            "recompiles": st["recompiles"],
+            "compactions": snap.get("compactions", 0),
+            "max_side_rows": int(max(side_series)),
+            "final_side_rows": int(side_series[-1]),
+            "base_live_bytes": int(base_bytes),
+            "max_live_bytes": int(max(bytes_series)),
+            "side_rows_series": [int(s) for s in side_series],
+            "peak_rebuild_bytes": last.get("projected_peak_bytes"),
+            "budget_bytes": last.get("budget_bytes"),
+        }
+
+    on = run_arm(True)
+    off = run_arm(False)
+
+    # the claims the record freezes — fail loudly rather than freeze lies
+    assert on["max_side_rows"] <= 2 * pol.max_side_rows, on
+    assert on["max_live_bytes"] <= 1.5 * on["base_live_bytes"], on
+    assert on["recall"] >= off["recall"], (on["recall"], off["recall"])
+    assert on["recompiles"] == 0, on
+    assert on["compactions"] >= 3, on
+    assert on["peak_rebuild_bytes"] <= on["budget_bytes"], on
+    off_side = off["side_rows_series"]
+    assert all(b > a for a, b in zip(off_side, off_side[1:])), off_side
+    assert off["final_side_rows"] >= cycles * churn_rows, off
+
+    _emit(
+        {
+            "metric": f"serve_compact_churn_bf_n{n}_c{cycles}_k{k}",
+            "value": on["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "arms": {"on": on, "off": off},
+            "recall": on["recall"],
+            "recompiles": on["recompiles"],
+            "compactions": on["compactions"],
+            "bounded_side_rows": on["max_side_rows"],
+            "unbounded_side_rows": off["final_side_rows"],
+            "trigger_side_rows": pol.max_side_rows,
+            "headroom_frac": pol.headroom_frac,
+            "n": n,
+            "cycles": cycles,
+            "churn_rows": churn_rows,
+            "queries": n_q,
         }
     )
 

@@ -296,3 +296,64 @@ def refresh_live_buffer_gauges(
             if (d["index"], d["version"]) not in alive_keys:
                 gauge.remove(**d)
     return live
+
+
+def refresh_mutation_gauges(
+    index_registry, registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Publish per-index mutation-pressure gauges from the registry's
+    *current* entries: ``raft_tpu_index_pending_deletes``,
+    ``raft_tpu_index_side_rows``, and ``raft_tpu_index_tombstone_frac``
+    (tombstones over main rows, construction padding excluded).
+
+    These are the compaction trigger inputs — the same numbers
+    :class:`~raft_tpu.serve.compactor.Compactor` compares against its
+    policy — so compaction pressure is visible in ``prometheus()``
+    output, not only via method calls.  Entries that are not
+    :class:`~raft_tpu.serve.mutation.MutableIndex` (sharded indexes,
+    raw wrappers without a side buffer) are skipped; series for names
+    no longer registered are removed, mirroring
+    :func:`refresh_live_buffer_gauges`.
+    """
+    reg = registry if registry is not None else default_registry()
+    g_del = reg.gauge(
+        "raft_tpu_index_pending_deletes",
+        help="tombstoned rows awaiting compaction (padding excluded)",
+    )
+    g_side = reg.gauge(
+        "raft_tpu_index_side_rows",
+        help="live upsert rows in the brute-force side buffer",
+    )
+    g_frac = reg.gauge(
+        "raft_tpu_index_tombstone_frac",
+        help="pending deletes over main structure rows",
+    )
+    out: Dict[str, Dict[str, float]] = {}
+    alive = set()
+    for name in index_registry.names():
+        try:
+            index = index_registry.get(name)
+            deletes, side = index.pending_mutations()
+            denom = max(
+                index.main_size - getattr(index, "_n_structural", 0), 1
+            )
+        except (KeyError, AttributeError):
+            continue
+        except Exception:
+            continue
+        frac = float(deletes) / float(denom)
+        g_del.set(deletes, index=name)
+        g_side.set(side, index=name)
+        g_frac.set(frac, index=name)
+        alive.add(name)
+        out[name] = {
+            "pending_deletes": float(deletes),
+            "side_rows": float(side),
+            "tombstone_frac": frac,
+        }
+    for gauge in (g_del, g_side, g_frac):
+        for key in gauge.series():
+            d = dict(key)
+            if d.get("index") not in alive:
+                gauge.remove(**d)
+    return out

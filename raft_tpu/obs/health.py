@@ -67,6 +67,10 @@ class IndexProbe:
     inflight: int = 0                       # device batches currently in flight
     recall_ewma: Optional[float] = None     # None: auditor off / no audits yet
     recall_threshold: Optional[float] = None
+    # compaction signals (None throughout: no compactor attached)
+    compaction_backlog: Optional[int] = None   # pending deletes + side rows
+    compaction_trigger: Optional[int] = None   # rows at which a pass fires
+    compaction_last_abort: Optional[str] = None  # unresolved abort reason
 
 
 def _check(status: str, detail: str) -> Dict[str, str]:
@@ -139,6 +143,33 @@ def index_health(probe: IndexProbe) -> Dict[str, object]:
     else:
         checks["recall"] = _check(
             OK, f"recall ewma {probe.recall_ewma:.3f}"
+        )
+
+    # compaction: an unresolved abort means maintenance is wedged (the
+    # backlog keeps growing until an operator looks), and a backlog far
+    # past the trigger means the compactor cannot keep up with churn —
+    # both are DEGRADED, never UNHEALTHY: serving itself still answers.
+    if probe.compaction_backlog is None:
+        checks["compaction"] = _check(OK, "no compactor attached")
+    elif probe.compaction_last_abort:
+        checks["compaction"] = _check(
+            DEGRADED,
+            f"last compaction aborted ({probe.compaction_last_abort}); "
+            f"backlog {probe.compaction_backlog}",
+        )
+    elif (
+        probe.compaction_trigger
+        and probe.compaction_backlog
+        > QUEUE_DEGRADED_FACTOR * probe.compaction_trigger
+    ):
+        checks["compaction"] = _check(
+            DEGRADED,
+            f"compaction backlog {probe.compaction_backlog} >> trigger "
+            f"{probe.compaction_trigger} (compactor falling behind)",
+        )
+    else:
+        checks["compaction"] = _check(
+            OK, f"compaction backlog {probe.compaction_backlog}"
         )
 
     status = worst(*(c["status"] for c in checks.values()))

@@ -18,6 +18,10 @@ indexes that change while being served.  Five pieces:
   reports into the process-wide :mod:`raft_tpu.obs` registry.
 - :mod:`~raft_tpu.serve.replica` — query-sharded multi-chip dispatch over
   a replicated index (comms/ mesh).
+- :mod:`~raft_tpu.serve.compactor` — online compaction: a background
+  worker that folds tombstones + side buffer back into the main
+  structure via memory-budgeted shadow rebuilds, recall-gated atomic
+  promotion, and zero post-swap recompiles.
 - :mod:`~raft_tpu.serve.shard` — ``ShardedIndex``: the index itself
   partitioned across the mesh axis (brute-force rows / IVF lists), each
   shard running the existing local search with one cross-shard tie-stable
@@ -32,6 +36,7 @@ books XLA cost/memory figures per bucket into the registry.  See
 """
 
 from raft_tpu.serve.batcher import MicroBatcher
+from raft_tpu.serve.compactor import CompactionPolicy, Compactor
 from raft_tpu.serve.metrics import (
     ServingMetrics,
     compile_count,
@@ -48,6 +53,8 @@ from raft_tpu.serve.service import SearchService
 from raft_tpu.serve.shard import ShardedIndex, shard_index
 
 __all__ = [
+    "CompactionPolicy",
+    "Compactor",
     "IndexRegistry",
     "MicroBatcher",
     "MutableIndex",

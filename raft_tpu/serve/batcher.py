@@ -264,10 +264,10 @@ class MicroBatcher:
         with self._dispatch_lock, trace_range("serve.warmup"):
             for b in self.buckets():
                 dummy = np.zeros((b, self.dim), dtype=np.float32)
-                c0 = compile_count()
+                c0 = compile_count(thread=True)
                 dist, ids = self._search_fn(jax.numpy.asarray(dummy))
                 jax.block_until_ready((dist, ids))
-                total += compile_count() - c0
+                total += compile_count(thread=True) - c0
                 if self.cost_accounting:
                     self._account_bucket_cost(b, dummy)
         self.metrics.record_warmup(total)
@@ -548,7 +548,7 @@ class MicroBatcher:
         t_pad = time.perf_counter() - t_start
         sp = None
         try:
-            c0 = compile_count()
+            c0 = compile_count(thread=True)
             with trace_range("serve.batch") as sp:
                 t0 = time.perf_counter()
                 # dispatch: host-side tracing + enqueue of the executable
@@ -562,13 +562,13 @@ class MicroBatcher:
                     sp.add_stage("pad", t_pad)
                     sp.add_stage("dispatch", t1 - t0)
                     sp.add_stage("device", t2 - t1)
-            compiles = compile_count() - c0
+            compiles = compile_count(thread=True) - c0
             dist = np.asarray(dist)
             ids = np.asarray(ids)
         except Exception as exc:  # noqa: BLE001 — fail the waiting futures
             self._record_flight(
                 seq=seq, batch=batch, n=n, bucket=bucket,
-                compiles=compile_count() - c0,
+                compiles=compile_count(thread=True) - c0,
                 t_pickup=t_start, t_done=time.perf_counter(),
                 stages_s={"pad": t_pad},
                 waits_s={"queue": max(queue_waits, default=0.0)},
@@ -725,21 +725,21 @@ class MicroBatcher:
             # detached span: opened here, closed by the completion thread
             rec.sp = spans.open_span("serve.batch")
             try:
-                c0 = compile_count()
+                c0 = compile_count(thread=True)
                 t1 = time.perf_counter()
                 dist, ids = self._search_fn(jax.numpy.asarray(padded))
                 t2 = time.perf_counter()
                 rec.t_dispatch = t2 - t1
                 # compiles happen synchronously at trace/enqueue time, so
                 # the bracket closes here, not after the device wait
-                rec.compiles = compile_count() - c0
+                rec.compiles = compile_count(thread=True) - c0
                 rec.dist, rec.ids = dist, ids
             except Exception as exc:  # noqa: BLE001 — fail only this batch
                 spans.finish_span(rec.sp)
                 self._inflight_sem.release()
                 self._record_flight(
                     seq=rec.seq, batch=batch, n=n, bucket=bucket,
-                    compiles=compile_count() - c0,
+                    compiles=compile_count(thread=True) - c0,
                     t_pickup=t_acquired, t_done=time.perf_counter(),
                     stages_s={"pad": rec.t_pad},
                     waits_s={

@@ -44,6 +44,7 @@ STAGES = ("queue", "pad", "inflight_wait", "dispatch", "device")
 # ---- process-wide XLA compile counter -------------------------------------
 
 _compile_count = 0
+_compile_count_by_thread: Dict[int, int] = {}
 _listener_installed = False
 _listener_lock = threading.Lock()
 # jax invokes duration listeners from whatever thread triggered the compile;
@@ -59,8 +60,12 @@ def _on_event_duration(name: str, duration: float, *args, **kwargs) -> None:
     # inside jax.monitoring and silently kill the listener
     global _compile_count
     if name == "/jax/core/compile/backend_compile_duration":
+        tid = threading.get_ident()
         with _count_lock:
             _compile_count += 1
+            _compile_count_by_thread[tid] = (
+                _compile_count_by_thread.get(tid, 0) + 1
+            )
 
 
 def install_compile_listener() -> None:
@@ -75,10 +80,21 @@ def install_compile_listener() -> None:
         _listener_installed = True
 
 
-def compile_count() -> int:
-    """Total XLA backend compiles observed in this process so far."""
+def compile_count(thread: bool = False) -> int:
+    """XLA backend compiles observed in this process so far.
+
+    ``thread=True`` restricts the count to compiles triggered *by the
+    calling thread* — jax delivers the duration event synchronously on
+    the compiling thread, so a dispatch bracket on the batcher thread
+    stays blind to concurrent compiles from background work (a
+    compaction shadow rebuild, a warmup on another service).  The
+    default process-total keeps the old semantics for benches and
+    single-threaded callers.
+    """
     install_compile_listener()
     with _count_lock:
+        if thread:
+            return _compile_count_by_thread.get(threading.get_ident(), 0)
         return _compile_count
 
 

@@ -88,11 +88,12 @@ def _bitset_from_np(mask: np.ndarray) -> Bitset:
 class _Snapshot:
     """Immutable view a search runs against (see thread-safety note)."""
 
-    tombstones: Optional[Bitset]     # over main ids, None when no deletes
+    tombstones: Optional[Bitset]     # over main rows, None when no deletes
     side_data: Optional[jax.Array]   # [cap, dim] padded, None when empty
     side_ids: Optional[jax.Array]    # [cap] global ids (-1 on dead slots)
     side_live: Optional[Bitset]      # pass-filter over side slots
     generation: int
+    main_ids: Optional[jax.Array] = None  # row → global id, None = identity
 
 
 class MutableIndex:
@@ -109,9 +110,19 @@ class MutableIndex:
     search_params:
         Per-kind ``SearchParams`` for the main search (ignored for
         brute_force).  Defaults to the backend's defaults.
+    main_ids:
+        Optional ``[index.size]`` int array mapping main *row* i to its
+        global id.  A compacted shadow rebuild packs surviving rows
+        densely (builders assign 0..m-1) but must keep serving the
+        original ids — the map is applied after the main search and
+        before the side-buffer merge.  Tombstones stay row-indexed
+        (the in-search filter tests the backend's stored ids, which are
+        rows).  ``None`` (the default, and what direct builds want)
+        means identity.
     """
 
-    def __init__(self, index, *, kind: Optional[str] = None, search_params=None):
+    def __init__(self, index, *, kind: Optional[str] = None, search_params=None,
+                 main_ids: Optional[np.ndarray] = None):
         self.kind = kind if kind is not None else _infer_kind(index)
         mod = _kind_module(self.kind)  # validates kind
         self.index = index
@@ -122,17 +133,44 @@ class MutableIndex:
             search_params = mod.SearchParams()
         self.search_params = search_params
 
+        if main_ids is not None:
+            main_ids = np.asarray(main_ids, dtype=np.int64).reshape(-1)
+            if main_ids.shape[0] != self.main_size:
+                raise ValueError(
+                    f"main_ids has {main_ids.shape[0]} entries for "
+                    f"{self.main_size} main rows"
+                )
+            if np.array_equal(main_ids, np.arange(self.main_size)):
+                main_ids = None  # identity: keep the remap off the search
+
         self._lock = threading.Lock()
-        # main-id tombstones, host-side; packed lazily into a Bitset
+        # row → global id map; immutable post-construction like the main
+        # structure, so its device copy is built once here (not per snapshot)
+        self._main_ids = main_ids
+        self._main_ids_dev = (
+            jnp.asarray(main_ids.astype(np.int32))
+            if main_ids is not None else None
+        )
+        # main-row tombstones, host-side; packed lazily into a Bitset
         self._deleted = np.zeros((self.main_size,), dtype=bool)
         self._n_deleted = 0
+        # rows tombstoned at construction (compaction padding sentinels):
+        # part of the filter, but not mutation backlog — pending_mutations
+        # subtracts them so a fresh compaction doesn't re-trigger itself
+        self._n_structural = 0
         # side buffer, host-side source of truth
         self._side_data = np.zeros((0, self.dim), dtype=np.float32)
         self._side_ids = np.zeros((0,), dtype=np.int64)
         self._side_live = np.zeros((0,), dtype=bool)
         self._side_count = 0          # occupied slots (live or dead)
-        self._next_id = self.main_size
+        self._next_id = (
+            self.main_size if main_ids is None
+            else (int(main_ids.max()) + 1 if main_ids.size else 0)
+        )
         self._generation = 0
+        # set by a compaction promote: mutations arriving after the
+        # hot-swap forward to the replacement so they are never lost
+        self._retired_to: Optional["MutableIndex"] = None
         self._snapshot_cache: Optional[_Snapshot] = None
         self._refresh_snapshot_locked()
 
@@ -163,6 +201,7 @@ class MutableIndex:
             return int(nb) if isinstance(nb, (int, np.integer)) else 0
 
         total = sum(_nb(v) for v in vars(self.index).values())
+        total += _nb(self._main_ids) + _nb(self._main_ids_dev)
         with self._lock:
             total += _nb(self._side_data) + _nb(self._side_ids)
             total += _nb(self._side_live) + _nb(self._deleted)
@@ -177,10 +216,19 @@ class MutableIndex:
 
     def contains(self, id_: int) -> bool:
         with self._lock:
-            if 0 <= id_ < self.main_size and not self._deleted[id_]:
-                return True
-            hits = (self._side_ids == id_) & self._side_live
-            return bool(hits.any())
+            if self._retired_to is not None:
+                succ = self._retired_to
+            else:
+                if self._main_ids is None:
+                    if 0 <= id_ < self.main_size and not self._deleted[id_]:
+                        return True
+                else:
+                    rows = np.flatnonzero(self._main_ids == id_)
+                    if rows.size and not self._deleted[rows[0]]:
+                        return True
+                hits = (self._side_ids == id_) & self._side_live
+                return bool(hits.any())
+        return succ.contains(id_)
 
     # -- mutation ------------------------------------------------------------
     @traced("serve.upsert")
@@ -198,42 +246,57 @@ class MutableIndex:
             )
         m = vectors.shape[0]
         with self._lock:
-            if ids is None:
-                ids = np.arange(self._next_id, self._next_id + m, dtype=np.int64)
-                self._next_id += m
+            if self._retired_to is not None:
+                # compaction promoted a successor while the caller held a
+                # reference to this version: forward so the write lands in
+                # the serving index instead of vanishing with this one
+                succ = self._retired_to
             else:
-                ids = np.atleast_1d(np.asarray(ids, dtype=np.int64))
-                if ids.shape != (m,):
-                    raise ValueError(
-                        f"ids shape {ids.shape} does not match {m} vectors"
+                if ids is None:
+                    ids = np.arange(
+                        self._next_id, self._next_id + m, dtype=np.int64
                     )
-                self._delete_locked(ids)
-                self._next_id = max(self._next_id, int(ids.max()) + 1)
-            self._reserve_locked(self._side_count + m)
-            sl = slice(self._side_count, self._side_count + m)
-            self._side_data[sl] = vectors
-            self._side_ids[sl] = ids
-            self._side_live[sl] = True
-            self._side_count += m
-            self._bump_locked()
-        return ids
+                    self._next_id += m
+                else:
+                    ids = np.atleast_1d(np.asarray(ids, dtype=np.int64))
+                    if ids.shape != (m,):
+                        raise ValueError(
+                            f"ids shape {ids.shape} does not match {m} vectors"
+                        )
+                    self._delete_locked(ids)
+                    self._next_id = max(self._next_id, int(ids.max()) + 1)
+                self._reserve_locked(self._side_count + m)
+                sl = slice(self._side_count, self._side_count + m)
+                self._side_data[sl] = vectors
+                self._side_ids[sl] = ids
+                self._side_live[sl] = True
+                self._side_count += m
+                self._bump_locked()
+                return ids
+        return succ.upsert(vectors, ids)
 
     @traced("serve.delete")
     def delete(self, ids) -> int:
         """Tombstone ids (main or side); returns how many were live."""
         ids = np.atleast_1d(np.asarray(ids, dtype=np.int64))
         with self._lock:
-            n = self._delete_locked(ids)
-            self._bump_locked()
-        return n
+            if self._retired_to is None:
+                n = self._delete_locked(ids)
+                self._bump_locked()
+                return n
+            succ = self._retired_to
+        return succ.delete(ids)
 
     def _delete_locked(self, ids: np.ndarray) -> int:
         n_removed = 0
-        main = ids[(ids >= 0) & (ids < self.main_size)]
-        if main.size:
-            was_live = ~self._deleted[main]
-            n_removed += int(np.unique(main[was_live]).size)
-            self._deleted[main] = True
+        if self._main_ids is None:
+            rows = ids[(ids >= 0) & (ids < self.main_size)]
+        else:
+            rows = np.flatnonzero(np.isin(self._main_ids, ids))
+        if rows.size:
+            was_live = ~self._deleted[rows]
+            n_removed += int(np.unique(rows[was_live]).size)
+            self._deleted[rows] = True
             self._n_deleted = int(self._deleted.sum())
         if self._side_count:
             hits = np.isin(self._side_ids, ids) & self._side_live
@@ -278,7 +341,8 @@ class MutableIndex:
         else:
             side_data = side_ids = side_live = None
         self._snapshot_cache = _Snapshot(
-            tomb, side_data, side_ids, side_live, self._generation
+            tomb, side_data, side_ids, side_live, self._generation,
+            self._main_ids_dev,
         )
 
     # -- search --------------------------------------------------------------
@@ -309,6 +373,12 @@ class MutableIndex:
         snap = self._snapshot()
         with trace_range("serve.mutable_search"):
             dist, ids = self._main_search(queries, k, snap.tombstones)
+            if snap.main_ids is not None:
+                # compacted index: the backend returned dense row ids;
+                # remap to the global ids callers know (-1 stays -1)
+                ids = jnp.where(
+                    ids >= 0, snap.main_ids[jnp.clip(ids, 0)], -1
+                )
             if snap.side_data is None:
                 return dist, ids
             from raft_tpu.neighbors import brute_force
@@ -333,9 +403,15 @@ class MutableIndex:
 
     # -- maintenance ---------------------------------------------------------
     def pending_mutations(self) -> Tuple[int, int]:
-        """(tombstoned main rows, live side rows) — rebuild pressure."""
+        """(tombstoned main rows, live side rows) — rebuild pressure.
+
+        Construction-time padding sentinels (compacted indexes) are
+        excluded: they are filter state, not backlog."""
         with self._lock:
-            return self._n_deleted, int(self._side_live.sum())
+            return (
+                self._n_deleted - self._n_structural,
+                int(self._side_live.sum()),
+            )
 
     def live_vectors(self) -> Tuple[np.ndarray, np.ndarray]:
         """Materialize (vectors, ids) of every live row — rebuild input.
@@ -346,13 +422,59 @@ class MutableIndex:
         with self._lock:
             keep = ~self._deleted
             main_rows = np.asarray(self._main_dataset())[keep]
-            main_ids = np.nonzero(keep)[0].astype(np.int64)
+            if self._main_ids is None:
+                main_ids = np.nonzero(keep)[0].astype(np.int64)
+            else:
+                main_ids = self._main_ids[keep]
             side_rows = self._side_data[self._side_live]
             side_ids = self._side_ids[self._side_live]
         return (
             np.concatenate([main_rows, side_rows], axis=0),
             np.concatenate([main_ids, side_ids], axis=0),
         )
+
+    def iter_main_rows(self, chunk_rows: int = 65536):
+        """Yield ``(row_indices, rows)`` chunks of the main dataset.
+
+        The memory-bounded path a compaction rebuild uses instead of
+        :meth:`live_vectors`: each step materializes at most roughly
+        ``chunk_rows`` decoded float32 rows (plus one list-data slab for
+        the IVF kinds), never the whole dataset.  The main structure is
+        immutable, so iteration needs no lock; row indices are positions
+        0..main_size-1 — map through the id map (if any) and the caller's
+        captured tombstone mask to get live global ids.
+        """
+        chunk_rows = max(1, int(chunk_rows))
+        if self.kind in ("brute_force", "cagra"):
+            data = self.index.dataset
+            for a in range(0, self.main_size, chunk_rows):
+                b = min(a + chunk_rows, self.main_size)
+                yield (
+                    np.arange(a, b, dtype=np.int64),
+                    np.asarray(data[a:b], dtype=np.float32),
+                )
+            return
+        # IVF kinds: rows live scattered across padded lists — chunk over
+        # lists so each step slices a bounded slab of list_data
+        list_index = np.asarray(self.index.list_index)
+        n_lists, cap = list_index.shape
+        lists_per = max(1, chunk_rows // max(cap, 1))
+        if self.kind == "ivf_pq":
+            rot = np.asarray(self.index.rotation, dtype=np.float32)
+            scale = float(self.index.scan_scale)
+        for l0 in range(0, n_lists, lists_per):
+            l1 = min(l0 + lists_per, n_lists)
+            idx = list_index[l0:l1]
+            valid = idx >= 0
+            if not valid.any():
+                continue
+            data = np.asarray(self.index.list_data[l0:l1], dtype=np.float32)
+            rows = data[valid]
+            if self.kind == "ivf_pq":
+                # decoded reconstructions live in rotated space (possibly
+                # int8 scan cache, hence scan_scale); invert the rotation
+                rows = (rows * scale) @ rot
+            yield idx[valid].astype(np.int64), rows
 
     def _main_dataset(self) -> np.ndarray:
         """Recover the main rows in id order (for rebuild/consistency)."""
@@ -383,6 +505,7 @@ class MutableIndex:
                 "side_count": self._side_count,
                 "next_id": self._next_id,
                 "generation": self._generation,
+                "n_structural": self._n_structural,
                 "dim": self.dim,
             }
             arrays = {
@@ -391,6 +514,10 @@ class MutableIndex:
                 "side_ids": self._side_ids,
                 "side_live": self._side_live,
             }
+            if self._main_ids is not None:
+                # compacted indexes serve remapped ids; dropping the map on
+                # restore would silently re-serve dense row ids
+                arrays["main_ids"] = self._main_ids
             ser.save_tree(
                 path, "serve_mutable", _SERVE_SERIALIZATION_VERSION,
                 scalars, arrays,
@@ -407,7 +534,12 @@ class MutableIndex:
         )
         mod = _kind_module(scalars["kind"])
         index = mod.load(path + ".main")
-        out = cls(index, kind=scalars["kind"], search_params=search_params)
+        # files written before the id map existed have no "main_ids" key —
+        # they were identity-mapped by construction
+        out = cls(
+            index, kind=scalars["kind"], search_params=search_params,
+            main_ids=arrays.get("main_ids"),
+        )
         with out._lock:
             out._deleted = np.asarray(arrays["deleted"], dtype=bool)
             out._n_deleted = int(out._deleted.sum())
@@ -417,5 +549,7 @@ class MutableIndex:
             out._side_count = int(scalars["side_count"])
             out._next_id = int(scalars["next_id"])
             out._generation = int(scalars["generation"])
+            # older files predate compaction padding; they had none
+            out._n_structural = int(scalars.get("n_structural", 0))
             out._refresh_snapshot_locked()
         return out

@@ -31,7 +31,7 @@ Typical lifecycle::
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
 
 from raft_tpu import obs
 from raft_tpu.core.trace import traced
@@ -39,6 +39,7 @@ from raft_tpu.obs import cost as obs_cost
 from raft_tpu.obs import health as obs_health
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.serve.batcher import MicroBatcher
+from raft_tpu.serve.compactor import CompactionPolicy, Compactor
 from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
 from raft_tpu.serve.registry import IndexRegistry
@@ -62,6 +63,7 @@ class SearchService:
         auditor: Optional[QualityAuditor] = None,
         cost_accounting: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
+        compaction: Union[None, bool, CompactionPolicy, Compactor] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -82,6 +84,20 @@ class SearchService:
         self._start = start
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
+        self._ks: Dict[str, int] = {}  # effective k per served name
+        # compaction=None/False: no worker.  True: policy from env.  A
+        # CompactionPolicy: worker with that policy.  A prebuilt Compactor
+        # is adopted as-is (its own start state respected).
+        self.compactor: Optional[Compactor] = None
+        if isinstance(compaction, Compactor):
+            self.compactor = compaction
+        elif isinstance(compaction, CompactionPolicy):
+            self.compactor = Compactor(self, compaction, start=start)
+        elif compaction:
+            self.compactor = Compactor(
+                self,
+                start=start and not CompactionPolicy.disabled_by_env(),
+            )
 
     # -- index management ----------------------------------------------------
     def add_index(
@@ -101,6 +117,7 @@ class SearchService:
         version = self.registry.register(name, index)
         k = self.k if k is None else int(k)
         with self._lock:
+            self._ks[name] = k
             old = self._batchers.pop(name, None)
             batcher = MicroBatcher(
                 self._make_search_fn(name, k),
@@ -188,6 +205,7 @@ class SearchService:
     def remove_index(self, name: str) -> None:
         with self._lock:
             batcher = self._batchers.pop(name)
+            self._ks.pop(name, None)
         batcher.stop()
         self.registry.unregister(name)
 
@@ -224,6 +242,35 @@ class SearchService:
         names = [name] if name is not None else self.names()
         return sum(self._batcher(n).flush() for n in names)
 
+    # -- compaction ----------------------------------------------------------
+    def compact_now(self, name: str) -> Dict[str, object]:
+        """Run one synchronous compaction pass for ``name``, bypassing the
+        policy thresholds and any abort cooldown (operator escape hatch).
+        Requires the service to own a compactor (``compaction=`` knob)."""
+        if self.compactor is None:
+            raise RuntimeError(
+                "no compactor attached; construct the service with "
+                "compaction=True (or a CompactionPolicy)"
+            )
+        return self.compactor.trigger_now(name)
+
+    def pause_compaction(self) -> None:
+        """Suspend automatic compaction triggering (a running pass
+        finishes; :meth:`compact_now` still works)."""
+        if self.compactor is not None:
+            self.compactor.pause()
+
+    def resume_compaction(self) -> None:
+        if self.compactor is not None:
+            self.compactor.resume()
+
+    def drain_compaction(self, timeout: Optional[float] = None) -> bool:
+        """Block until no compaction pass is in flight; True on success
+        (vacuously so when no compactor is attached)."""
+        if self.compactor is None:
+            return True
+        return self.compactor.drain(timeout=timeout)
+
     # -- observability -------------------------------------------------------
     def stats(self, name: str) -> Dict[str, object]:
         """Metrics snapshot + index version/size for one served name.
@@ -254,6 +301,10 @@ class SearchService:
             obs_cost.refresh_live_buffer_gauges(self.registry)
         except Exception:  # capacity accounting must never break serving
             pass
+        try:
+            obs_cost.refresh_mutation_gauges(self.registry)
+        except Exception:  # mutation pressure gauges likewise
+            pass
 
     def healthz(self) -> Dict[str, object]:
         """Aggregated health verdict: OK / DEGRADED / UNHEALTHY.
@@ -282,6 +333,13 @@ class SearchService:
                 b = self._batcher(name)
             except KeyError:  # removed between names() and here
                 continue
+            compaction: Dict[str, object] = {}
+            if self.compactor is not None:
+                try:
+                    compaction = self.compactor.stats(name)
+                except Exception:
+                    compaction = {}
+            last_abort = compaction.get("last_abort")
             probes[name] = obs_health.IndexProbe(
                 warm=b.warm,
                 recompiles=b.metrics.recompiles,
@@ -294,6 +352,13 @@ class SearchService:
                 ),
                 recall_threshold=(
                     auditor.threshold if auditor is not None else None
+                ),
+                compaction_backlog=compaction.get("backlog"),
+                compaction_trigger=compaction.get("trigger"),
+                compaction_last_abort=(
+                    str(last_abort.get("reason", "unknown"))
+                    if isinstance(last_abort, dict)
+                    else None
                 ),
             )
         return obs_health.build_report(probes, registry=obs.default_registry())
@@ -354,6 +419,10 @@ class SearchService:
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:
+        # compactor first: a pass mid-flight may still submit warmup work
+        # through the batchers it is about to go down with
+        if self.compactor is not None:
+            self.compactor.stop()
         with self._lock:
             batchers = list(self._batchers.values())
         for b in batchers:

@@ -176,6 +176,15 @@ class ShardedIndex:
                         "cannot shard a MutableIndex with live side-buffer "
                         "rows; rebuild/compact the index first"
                     )
+                if index._main_ids is not None:
+                    # the sharded layouts carry global ids as row positions
+                    # (arange rows / list_index); a compacted id map would
+                    # silently serve wrong ids through them
+                    raise ValueError(
+                        "cannot shard a MutableIndex with a remapped id "
+                        "space (a compacted index); rebuild it with dense "
+                        "ids from live_vectors() first"
+                    )
                 if index._n_deleted:
                     deleted = index._deleted.copy()
             if search_params is None:

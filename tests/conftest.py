@@ -11,6 +11,7 @@ jax.config, which works because no backend has been initialized yet.
 """
 
 import os
+import sys
 
 import jax
 
@@ -78,6 +79,11 @@ def _reset_obs_globals(monkeypatch, tmp_path):
     spans.clear_recent()
     spans.set_ring_capacity()
     default_registry().clear_exemplars()
+    # stop any compactor workers a test left running (lazy: only if the
+    # module was imported — most tests never touch it)
+    compactor_mod = sys.modules.get("raft_tpu.serve.compactor")
+    if compactor_mod is not None:
+        compactor_mod.reset()
 
 
 @pytest.fixture

@@ -379,3 +379,44 @@ def test_flight_recorder_overhead_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_compact_churn_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the online-compaction A/B: run ``bench.py compact``
+    (compactor on vs off under identical churn) and gate it with
+    ``bench.py compare`` against the frozen record.  The run must show
+    bounded side rows and live bytes with the compactor on, monotone
+    side-buffer growth with it off, recall no worse than the off arm,
+    every promoted pass inside its memory budget, and zero post-warmup
+    hot-path recompiles — the leg asserts all of that itself before
+    emitting, so a zero exit plus a PASS compare is the whole story."""
+    candidate = str(tmp_path / "compact_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compact"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "compact leg recompiled on the hot path"
+    assert line["compactions"] >= 3
+    on, off = line["arms"]["on"], line["arms"]["off"]
+    assert on["max_side_rows"] <= 2 * line["trigger_side_rows"]
+    assert off["final_side_rows"] > 4 * line["trigger_side_rows"], (
+        "off arm failed to demonstrate unbounded growth"
+    )
+    assert on["recall"] >= off["recall"]
+    assert on["peak_rebuild_bytes"] <= on["budget_bytes"]
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_compact_r09.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

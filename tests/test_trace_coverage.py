@@ -100,6 +100,9 @@ SERVE_ENTRY_POINTS = {
     "SearchService.flush": "serve.flush",
     "MutableIndex.upsert": "serve.upsert",
     "MutableIndex.delete": "serve.delete",
+    "Compactor.compact": "serve.compact",
+    "Compactor.promote": "serve.compact.promote",
+    "Compactor.abort": "serve.compact.abort",
 }
 
 
