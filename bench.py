@@ -179,6 +179,9 @@ def main() -> None:
     if "flight" in sys.argv[1:]:
         run_flight_leg()
         return
+    if "analyze" in sys.argv[1:]:
+        run_analyze_leg()
+        return
     if probe_tpu() is not None:
         # verify cache serialization in a subprocess first — an unverified/
         # broken cache must never hang the bench
@@ -1101,6 +1104,38 @@ def run_obs_leg() -> None:
             "requests": n_requests,
         }
     )
+
+
+def run_analyze_leg() -> None:
+    """``python bench.py analyze`` — static-analysis smoke (host only).
+
+    Runs every :mod:`raft_tpu.analysis` checker over the package and
+    records the wall time, so the "analysis stays interactive" budget
+    (<10 s on CPU, enforced by tests/test_static_analysis.py) has a
+    tracked number per round alongside the perf legs.  Exits nonzero and
+    prints the rendered findings to stderr if any invariant is violated
+    — the same contract as ``python -m raft_tpu.analysis``.
+    """
+    from raft_tpu.analysis import run_analysis
+
+    t0 = time.perf_counter()
+    result = run_analysis()
+    wall = time.perf_counter() - t0
+    _emit(
+        {
+            "metric": "static_analysis_wall_s",
+            "value": round(wall, 3),
+            "unit": "s",
+            "platform": "host",
+            "findings": len(result.findings),
+            "suppressed": len(result.suppressed),
+            "stats": dict(sorted(result.stats.items())),
+        }
+    )
+    if result.findings:
+        for f in result.sorted_findings():
+            print(f.render(), file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

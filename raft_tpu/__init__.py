@@ -53,7 +53,7 @@ def _enable_persistent_compile_cache() -> None:
     ``RAFT_TPU_CACHE_DIR``. No-ops gracefully on JAX versions without the
     config knobs.
     """
-    if _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE"):
+    if _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE"):  # raft-tpu: ignore[ENVREG] package-init bootstrap, runs before core.env exists
         return
     if _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return  # the user already routed the cache; don't override
@@ -70,7 +70,7 @@ def _enable_persistent_compile_cache() -> None:
     xdg = _os.environ.get("XDG_CACHE_HOME") or _os.path.join(
         _os.path.expanduser("~"), ".cache"
     )
-    cache_dir = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.join(
+    cache_dir = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.join(  # raft-tpu: ignore[ENVREG] package-init bootstrap
         xdg, "raft_tpu", "jax_cache"
     )
     try:

@@ -1,0 +1,29 @@
+"""Checker registry: rule name → ``check(project, result)``.
+
+Each checker appends :class:`~raft_tpu.analysis.findings.Finding`
+objects to ``result.findings`` (suppressed ones to
+``result.suppressed``) and may record discovery counters in
+``result.stats`` — the vacuity guards in the tier-1 test read those, so
+a refactor that silently breaks discovery fails loudly instead of
+green-lighting everything.
+"""
+
+from __future__ import annotations
+
+from raft_tpu.analysis.checkers import (
+    envreg,
+    hostsync,
+    lockorder,
+    recompile,
+    traced,
+)
+
+CHECKERS = {
+    "RECOMPILE": recompile.check,
+    "HOSTSYNC": hostsync.check,
+    "LOCKORDER": lockorder.check,
+    "ENVREG": envreg.check,
+    "TRACED": traced.check,
+}
+
+__all__ = ["CHECKERS"]

@@ -1,0 +1,112 @@
+"""HOSTSYNC — device→host synchronization on the serving hot path.
+
+The serving pipeline's throughput story rests on dispatch staying
+asynchronous: the only intended host syncs are the staged copy-out at
+the end of a batch.  Anything else — ``.item()``, ``.tolist()``,
+``.block_until_ready()``, ``jax.device_get``, ``np.asarray`` on a
+device array, or ``float(x[0])`` — stalls the dispatch thread for a
+full device round-trip and serializes the pipeline.
+
+The checker computes the set of functions statically reachable from
+the hot-path roots (MicroBatcher dispatch/completion, the shard-merge
+and replica search paths) over resolved call edges and flags every
+sync-shaped operation inside them.  Intended syncs carry an inline
+``# raft-tpu: ignore[HOSTSYNC]`` with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.model import Project, call_name, dotted
+
+#: hot-path roots, matched by dotted-qualname suffix so the fixture
+#: package triggers the same contract
+ROOTS = (
+    "serve.batcher.MicroBatcher._dispatch_locked",
+    "serve.batcher.MicroBatcher._dispatch_pipelined",
+    "serve.batcher.MicroBatcher._complete",
+    "serve.service.SearchService.search",
+    "serve.mutation.MutableIndex.search",
+    "serve.shard.ShardedIndex.search",
+    "serve.replica.ReplicaGroup.search",
+)
+
+#: method calls that force a sync regardless of receiver type
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: import-resolved call targets that force a sync / host copy
+_SYNC_CALLS = {
+    "jax.block_until_ready": "blocks until device work completes",
+    "jax.device_get": "copies device buffers to host",
+    "numpy.asarray": "materializes a device array on host",
+    "numpy.array": "materializes a device array on host",
+    "numpy.copy": "materializes a device array on host",
+}
+
+
+def check(project: Project, result) -> None:
+    roots = []
+    for suffix in ROOTS:
+        roots.extend(project.functions_matching(suffix))
+    result.stats["hostsync_roots"] = len(roots)
+    reachable = project.reachable(roots)
+    result.stats["hostsync_reachable"] = len(reachable)
+
+    seen = set()
+    for fn in sorted(reachable, key=lambda f: f.qualname):
+        mod = fn.module
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            key = (mod.path, getattr(node, "lineno", 0),
+                   getattr(node, "col_offset", 0))
+            if key in seen:
+                continue
+            msg = _classify(mod, node)
+            if msg is None:
+                continue
+            seen.add(key)
+            f = project.finding(
+                "HOSTSYNC", mod, node, fn.qualname,
+                f"{msg} inside hot-path function",
+                suppressed_sink=result.suppressed,
+            )
+            if f is not None:
+                result.findings.append(f)
+
+
+def _classify(mod, call: ast.Call):
+    if isinstance(call.func, ast.Attribute):
+        name = call_name(mod, call)
+        if name in _SYNC_CALLS:
+            return f"`{dotted(call.func)}` {_SYNC_CALLS[name]}"
+        if call.func.attr in _SYNC_METHODS and dotted(call.func) is None:
+            # method on a computed receiver (e.g. result.dist.item())
+            return f"`.{call.func.attr}()` forces a device→host sync"
+        if (
+            call.func.attr in _SYNC_METHODS
+            and name not in _SYNC_CALLS
+            and not (name or "").startswith(("os.", "time.", "threading."))
+        ):
+            return f"`.{call.func.attr}()` forces a device→host sync"
+    elif isinstance(call.func, ast.Name):
+        if call.func.id in ("float", "int", "bool") and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Subscript) and not _static_chain(arg):
+                return (
+                    f"`{call.func.id}()` on an indexed array concretizes "
+                    "a device value"
+                )
+    return None
+
+
+#: attributes that are host-side metadata — int(x.shape[1]) never syncs
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes"}
+
+
+def _static_chain(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS
+        for n in ast.walk(node)
+    )

@@ -1,0 +1,363 @@
+"""RECOMPILE — jit-traced code that branches on traced values.
+
+The zero-recompile contract (pow2 bucket ladder + warmup; enforced
+dynamically by the bench-time compile counter) has a static shadow:
+inside a jit-traced function, Python control flow on the *value* of a
+traced argument either fails to trace or — worse — silently
+specializes, recompiling per distinct value.  ``.shape``/``.ndim``/
+``.dtype``/``len()`` are static under trace and fine to branch on;
+``static_argnums``/``static_argnames``/``functools.partial``-bound
+parameters are Python values by construction.
+
+Detected jit entries: ``@jax.jit`` / ``@partial(jax.jit, ...)``
+decorated defs, and local/module functions (or lambdas / partials)
+passed to an inline ``jax.jit(...)`` call.  Within them the checker
+taints non-static parameters, propagates through simple assignments,
+and flags ``if``/``while``/ternary/``assert`` tests, ``int()/float()/
+bool()`` concretizations and ``for`` iteration over tainted values.
+Inline-jitted closures are additionally checked for captured mutable
+Python containers (list/dict/set built in the enclosing scope): those
+are not hashable jit-cache keys and mutating them between calls skews
+tracing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from raft_tpu.analysis.model import (
+    ModuleInfo,
+    Project,
+    call_name,
+    dotted,
+    walk_scope,
+)
+
+#: attribute reads that are static under trace — they launder taint
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "sharding",
+                 "weak_type", "aval"}
+
+#: calls whose result is static regardless of traced operands
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray"}
+
+
+def check(project: Project, result) -> None:
+    n_entries = 0
+    for mod in project.modules.values():
+        entries = list(_jit_entries(project, mod))
+        n_entries += len(entries)
+        for node, static_idx, static_names, enclosing in entries:
+            _check_entry(project, mod, node, static_idx, static_names,
+                         result)
+            if enclosing is not None and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                _check_closure(project, mod, node, enclosing, result)
+    result.stats["recompile_jit_entries"] = n_entries
+
+
+# -- jit-entry discovery ----------------------------------------------------
+
+def _is_jit(mod: ModuleInfo, node: ast.AST) -> bool:
+    name = dotted(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    resolved = mod.imports.get(head, head) + ("." + rest if rest else "")
+    return resolved == "jax.jit"
+
+
+def _static_kwargs(
+    keywords: Iterable[ast.keyword],
+) -> Tuple[Set[int], Set[str]]:
+    idx: Set[int] = set()
+    names: Set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            for v in _int_values(kw.value):
+                idx.add(v)
+        elif kw.arg == "static_argnames":
+            for v in _str_values(kw.value):
+                names.add(v)
+    return idx, names
+
+
+def _int_values(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_int_values(e))
+        return out
+    return []
+
+
+def _str_values(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_str_values(e))
+        return out
+    return []
+
+
+def _jit_entries(project: Project, mod: ModuleInfo):
+    """Yield (def_or_lambda, static_idx, static_names, enclosing_fn)."""
+    # decorated defs (top-level and methods)
+    for fn in project.functions.values():
+        if fn.module is not mod:
+            continue
+        for dec in fn.node.decorator_list:
+            entry = _decorator_jit(mod, dec)
+            if entry is not None:
+                yield (fn.node, *entry, None)
+                break
+
+    # inline jax.jit(X, ...) calls, resolving X in its lexical scope
+    for scope, encl in _scopes(mod):
+        local_defs = {
+            n.name: n
+            for n in walk_scope(scope)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in walk_scope(scope):
+            if not (isinstance(node, ast.Call) and _is_jit(mod, node.func)
+                    and node.args):
+                continue
+            static_idx, static_names = _static_kwargs(node.keywords)
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                yield target, static_idx, static_names, encl
+            elif isinstance(target, ast.Name):
+                d = local_defs.get(target.id) or _module_def(mod, target.id)
+                if d is not None:
+                    yield d, static_idx, static_names, (
+                        encl if target.id in local_defs else None
+                    )
+            elif isinstance(target, ast.Call):
+                cn = call_name(mod, target)
+                if cn in ("functools.partial", "partial") and target.args:
+                    inner = target.args[0]
+                    if isinstance(inner, ast.Name):
+                        d = (local_defs.get(inner.id)
+                             or _module_def(mod, inner.id))
+                        if d is not None:
+                            bound_idx = set(range(len(target.args) - 1))
+                            bound_names = {
+                                kw.arg for kw in target.keywords if kw.arg
+                            }
+                            yield (d, static_idx | bound_idx,
+                                   static_names | bound_names, None)
+
+
+def _decorator_jit(mod: ModuleInfo, dec: ast.AST):
+    if _is_jit(mod, dec):
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        if _is_jit(mod, dec.func):
+            return _static_kwargs(dec.keywords)
+        cn = call_name(mod, dec)
+        if cn in ("functools.partial", "partial") and dec.args:
+            if _is_jit(mod, dec.args[0]):
+                return _static_kwargs(dec.keywords)
+    return None
+
+
+def _scopes(mod: ModuleInfo):
+    """(scope node, enclosing function-or-None) for module + every def."""
+    yield mod.tree, None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node
+
+
+def _module_def(mod: ModuleInfo, name: str):
+    for n in mod.tree.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == name:
+            return n
+    return None
+
+
+# -- taint analysis within one jit entry ------------------------------------
+
+def _params(node) -> List[str]:
+    a = node.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _check_entry(project, mod, node, static_idx, static_names, result):
+    a = node.args
+    positional = [p.arg for p in (a.posonlyargs + a.args)]
+    offset = 1 if positional[:1] in (["self"], ["cls"]) else 0
+    taint: Set[str] = set()
+    for i, p in enumerate(positional):
+        if p in ("self", "cls"):
+            continue
+        if (i - offset) in static_idx or p in static_names:
+            continue
+        taint.add(p)
+    for p in (x.arg for x in a.kwonlyargs):
+        if p not in static_names:
+            taint.add(p)
+    if not taint:
+        return
+
+    # propagate through simple assignments to a fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for n in ast.walk(node):
+            target_names: List[str] = []
+            value = None
+            if isinstance(n, ast.Assign):
+                value = n.value
+                for t in n.targets:
+                    target_names.extend(_name_targets(t))
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name
+            ):
+                value, target_names = n.value, [n.target.id]
+            elif isinstance(n, ast.AnnAssign) and isinstance(
+                n.target, ast.Name
+            ) and n.value is not None:
+                value, target_names = n.value, [n.target.id]
+            if value is None or not target_names:
+                continue
+            if _tainted(value, taint):
+                for t in target_names:
+                    if t not in taint:
+                        taint.add(t)
+                        changed = True
+
+    symbol = getattr(node, "name", "<lambda>")
+    qual = f"{mod.name}.{symbol}"
+    for n in ast.walk(node):
+        test = None
+        kind = None
+        if isinstance(n, (ast.If, ast.While)):
+            test, kind = n.test, "branches on"
+        elif isinstance(n, ast.IfExp):
+            test, kind = n.test, "branches on"
+        elif isinstance(n, ast.Assert):
+            test, kind = n.test, "asserts on"
+        if test is not None and _tainted(test, taint):
+            _emit(project, mod, n, qual, result,
+                  f"{kind} the value of traced "
+                  f"`{_first_tainted(test, taint)}` under jit — shape/"
+                  "dtype are static, values are not")
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in ("int", "float", "bool") and n.args:
+            if any(_tainted(arg, taint) for arg in n.args):
+                _emit(project, mod, n, qual, result,
+                      f"`{n.func.id}()` concretizes traced "
+                      f"`{_first_tainted(n.args[0], taint)}` under jit")
+            continue
+        if isinstance(n, ast.For) and _tainted(n.iter, taint):
+            _emit(project, mod, n, qual, result,
+                  "iterates over traced "
+                  f"`{_first_tainted(n.iter, taint)}` under jit — the "
+                  "loop unrolls per concrete length")
+
+
+def _name_targets(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_name_targets(e))
+        return out
+    return []
+
+
+def _tainted(expr: ast.AST, taint: Set[str]) -> bool:
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return _tainted(expr.value, taint)
+    if isinstance(expr, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+    ) and all(
+        isinstance(c, ast.Constant) and c.value is None
+        for c in expr.comparators
+    ):
+        # `x is None` tests pytree *structure*, which is static per trace
+        return False
+    if isinstance(expr, ast.Call):
+        fname = dotted(expr.func)
+        if fname in _STATIC_CALLS:
+            return False
+        return any(_tainted(c, taint) for c in ast.iter_child_nodes(expr))
+    if isinstance(expr, ast.Name):
+        return expr.id in taint
+    if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return False
+    return any(_tainted(c, taint) for c in ast.iter_child_nodes(expr))
+
+
+def _first_tainted(expr: ast.AST, taint: Set[str]) -> str:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in taint:
+            return n.id
+    return "<value>"
+
+
+def _emit(project, mod, node, symbol, result, message):
+    f = project.finding("RECOMPILE", mod, node, symbol, message,
+                        suppressed_sink=result.suppressed)
+    if f is not None:
+        result.findings.append(f)
+
+
+# -- mutable-capture check for inline-jitted closures -----------------------
+
+def _check_closure(project, mod, fn_node, enclosing, result):
+    local = set(_params(fn_node))
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            local.add(n.id)
+
+    mutable_outer: Dict[str, ast.AST] = {}
+    for n in walk_scope(enclosing):
+        if isinstance(n, ast.Assign) and isinstance(n.value, (
+                ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp)):
+            for t in n.targets:
+                for name in _name_targets(t):
+                    mutable_outer[name] = n
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            cn = dotted(n.value.func)
+            if cn in _MUTABLE_CTORS:
+                for t in n.targets:
+                    for name in _name_targets(t):
+                        mutable_outer[name] = n
+
+    symbol = f"{mod.name}.{getattr(fn_node, 'name', '<lambda>')}"
+    reported = set()
+    for n in ast.walk(fn_node):
+        if (
+            isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id in mutable_outer
+            and n.id not in local
+            and n.id not in reported
+        ):
+            reported.add(n.id)
+            f = project.finding(
+                "RECOMPILE", mod, n, symbol,
+                f"jit-compiled closure captures mutable Python container "
+                f"`{n.id}` from the enclosing scope — not a stable jit "
+                "cache key, and mutations after trace are invisible",
+                suppressed_sink=result.suppressed,
+            )
+            if f is not None:
+                result.findings.append(f)

@@ -14,12 +14,13 @@ import numpy as np
 # platform override must land before any backend is initialized (this image
 # pre-imports jax with the TPU platform forced; jax.config still wins if no
 # backend has been touched yet)
-if os.environ.get("RAFT_TPU_PLATFORM"):
+if os.environ.get("RAFT_TPU_PLATFORM"):  # raft-tpu: ignore[ENVREG] pre-jax bootstrap
     import jax
 
-    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])
+    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])  # raft-tpu: ignore[ENVREG] pre-jax bootstrap
 
 from raft_tpu.bench import datasets, export, plot, runner
+from raft_tpu.core import env as _env
 
 DEFAULT_CONFIG = {
     "algos": [
@@ -225,8 +226,9 @@ def main(argv=None):
                 "metric": f"bench_{out_name}_k{args.k}",
                 "value": round(head.qps, 1),
                 "unit": "queries/s",
-                "platform": "cpu" if os.environ.get(
-                    "RAFT_TPU_PLATFORM") == "cpu" else None,
+                "platform": "cpu"
+                if _env.env_str("RAFT_TPU_PLATFORM") == "cpu"
+                else None,
                 "recall": round(head.recall, 4),
                 "latency_ms": round(head.latency_ms, 3),
                 "algo": head.algo,

@@ -21,6 +21,7 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from raft_tpu.bench.runner import RunResult
+from raft_tpu.core import env as _env
 
 _FIELDS = [
     "algo", "dataset", "k", "build_param", "search_param",
@@ -77,7 +78,7 @@ def kernel_path(
 
             pallas = pallas_scan_enabled(metric, storage_dtype)
         else:
-            pallas = os.environ.get("RAFT_TPU_PALLAS") == "1"
+            pallas = _env.env_str("RAFT_TPU_PALLAS") == "1"
     return {"pallas": bool(pallas)}
 
 
@@ -113,7 +114,7 @@ def write_bench_record(
     when nobody asked for one.
     """
     if path is None:
-        path = os.environ.get(RECORD_PATH_ENV, DEFAULT_RECORD_PATH)
+        path = _env.env_str(RECORD_PATH_ENV, DEFAULT_RECORD_PATH)
     if not path or path == "-":
         return ""
     d = os.path.dirname(path)

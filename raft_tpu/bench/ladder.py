@@ -29,10 +29,10 @@ import time
 
 import numpy as np
 
-if os.environ.get("RAFT_TPU_PLATFORM"):
+if os.environ.get("RAFT_TPU_PLATFORM"):  # raft-tpu: ignore[ENVREG] pre-jax bootstrap
     import jax
 
-    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])
+    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])  # raft-tpu: ignore[ENVREG] pre-jax bootstrap
 
 # chip peaks for MFU accounting (per public TPU specs); fallback None → MFU
 # omitted on unknown platforms

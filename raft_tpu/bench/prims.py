@@ -23,11 +23,14 @@ from typing import Callable, Dict, List
 import numpy as np
 
 # platform override must land before any backend init (same contract as
-# raft_tpu.bench.__main__)
-if os.environ.get("RAFT_TPU_PLATFORM"):
+# raft_tpu.bench.__main__); direct read: core.env would import raft_tpu
+# and therefore jax before the platform override lands
+if os.environ.get("RAFT_TPU_PLATFORM"):  # raft-tpu: ignore[ENVREG] pre-jax bootstrap
     import jax
 
-    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])
+    jax.config.update("jax_platforms", os.environ["RAFT_TPU_PLATFORM"])  # raft-tpu: ignore[ENVREG] pre-jax bootstrap
+
+from raft_tpu.core import env as _env  # noqa: E402 — after platform override
 
 
 def _timeit(fn: Callable, args, warmup: int = 2, iters: int = 5) -> float:
@@ -150,7 +153,7 @@ def _cases() -> List[Dict]:
             # the Pallas gate is read per search call, so the A/B leg can
             # flip it around the dispatch (promotion evidence: VERDICT r3
             # item 10 — default-on requires this case to win on chip)
-            prev = os.environ.get("RAFT_TPU_PALLAS")
+            prev = _env.raw("RAFT_TPU_PALLAS")
             if _pallas:
                 os.environ["RAFT_TPU_PALLAS"] = "1"
             else:
@@ -184,7 +187,7 @@ def _cases() -> List[Dict]:
 
     for pallas in (False, True):
         def bf_fn(xx, qq, _pallas=pallas):
-            prev = os.environ.get("RAFT_TPU_PALLAS")
+            prev = _env.raw("RAFT_TPU_PALLAS")
             if _pallas:
                 os.environ["RAFT_TPU_PALLAS"] = "1"
             else:

@@ -30,6 +30,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from raft_tpu.comms.comms import Comms
+from raft_tpu.core import env as _env
 from raft_tpu.core.resources import Resources
 
 _init_lock = threading.Lock()
@@ -75,12 +76,12 @@ def initialize(
             coordinator_address is None
             and num_processes is None
             and process_id is None
-            and "RAFT_TPU_COORDINATOR" in os.environ
+            and _env.has("RAFT_TPU_COORDINATOR")
         ):
             missing = [
                 v
                 for v in ("RAFT_TPU_NUM_PROCS", "RAFT_TPU_PROC_ID")
-                if v not in os.environ
+                if not _env.has(v)
             ]
             if missing:
                 raise RuntimeError(
@@ -89,9 +90,9 @@ def initialize(
                     "RAFT_TPU_COORDINATOR/NUM_PROCS/PROC_ID must be "
                     "exported together)"
                 )
-            coordinator_address = os.environ["RAFT_TPU_COORDINATOR"]
-            num_processes = int(os.environ["RAFT_TPU_NUM_PROCS"])
-            process_id = int(os.environ["RAFT_TPU_PROC_ID"])
+            coordinator_address = _env.env_str("RAFT_TPU_COORDINATOR")
+            num_processes = _env.env_int("RAFT_TPU_NUM_PROCS")
+            process_id = _env.env_int("RAFT_TPU_PROC_ID")
         # CPU cross-process collectives need an explicit implementation.
         if os.environ.get("JAX_PLATFORMS", "") == "cpu" or (
             jax.config.jax_platforms == "cpu"
@@ -201,7 +202,7 @@ class CommsCluster:
             initialize(
                 self.coordinator_address, self.num_processes, self.process_id
             )
-        elif self.num_processes is None and "RAFT_TPU_COORDINATOR" in os.environ:
+        elif self.num_processes is None and _env.has("RAFT_TPU_COORDINATOR"):
             # launcher-provided rendezvous (the mpirun/srun contract — see
             # initialize()'s transport #2)
             initialize()

@@ -1,0 +1,239 @@
+"""Typed access to the ``RAFT_TPU_*`` environment knobs.
+
+Every knob the package reads is declared once in :data:`KNOWN_VARS` —
+name, type, default and one-line effect — and read through a typed
+accessor (:func:`env_str` / :func:`env_int` / :func:`env_float` /
+:func:`env_bool`).  The declaration table is the process-wide registry
+the ENVREG static checker (``raft_tpu.analysis``) reconciles against
+both the call sites and the README env table, so a knob cannot exist
+without documentation and documentation cannot outlive the knob.
+
+Reads stay point-of-use (no global config object is built from this
+table); the accessors only add name/type validation and a single place
+to define boolean semantics.  The few reads that must run before the
+package imports (the jax platform/compile-cache bootstrap in
+``raft_tpu/__init__.py`` and ``raft_tpu.bench.__main__``) keep direct
+``os.environ`` access with an inline suppression — importing this
+module there would drag ``raft_tpu.core`` (and jax) in too early.
+
+This module is importable without jax: the analysis CLI and the tier-1
+static tests load it standalone.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "EnvVar",
+    "KNOWN_VARS",
+    "UnknownEnvVarError",
+    "env_str",
+    "env_int",
+    "env_float",
+    "env_bool",
+    "has",
+    "raw",
+    "known",
+]
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared knob: the registry row the checkers reconcile."""
+
+    name: str
+    kind: str        # "str" | "int" | "float" | "bool"
+    default: str     # human-readable default, mirrors the README table
+    help: str        # one-line effect
+
+
+#: every environment variable the package (and its bench/test harnesses)
+#: reads — the single source of truth the README table must mirror
+KNOWN_VARS: Tuple[EnvVar, ...] = (
+    # -- serving -------------------------------------------------------------
+    EnvVar("RAFT_TPU_PIPELINE_DEPTH", "int", "2",
+           "serving in-flight window: device batches the MicroBatcher "
+           "overlaps; 1 = fully serial dispatch"),
+    EnvVar("RAFT_TPU_COST_ACCOUNTING", "bool", "1",
+           "0 skips the per-bucket XLA cost/memory gauges at warmup"),
+    EnvVar("RAFT_TPU_SHARD_MERGE_DTYPE", "str", "float32",
+           "bfloat16 quantizes the cross-shard merge all-gather of "
+           "ShardedIndex candidate distances"),
+    # -- compaction ----------------------------------------------------------
+    EnvVar("RAFT_TPU_COMPACT_DISABLED", "bool", "unset",
+           "1 keeps the compaction worker down even when "
+           "SearchService(compaction=True)"),
+    EnvVar("RAFT_TPU_COMPACT_MAX_SIDE_ROWS", "int", "1024",
+           "live side-buffer rows that trigger a compaction pass"),
+    EnvVar("RAFT_TPU_COMPACT_MAX_TOMBSTONE_FRAC", "float", "0.25",
+           "tombstoned fraction of main rows that triggers a pass"),
+    EnvVar("RAFT_TPU_COMPACT_INTERVAL_S", "float", "2.0",
+           "compaction worker scan period"),
+    EnvVar("RAFT_TPU_COMPACT_COOLDOWN_S", "float", "30",
+           "per-index re-arm delay after an aborted pass"),
+    EnvVar("RAFT_TPU_COMPACT_HEADROOM_FRAC", "float", "4.0",
+           "memory budget: projected peak rebuild bytes may not exceed "
+           "this fraction of the live index's bytes"),
+    EnvVar("RAFT_TPU_COMPACT_CHUNK_ROWS", "int", "65536",
+           "main-structure decode chunk during the shadow gather"),
+    EnvVar("RAFT_TPU_COMPACT_GATE_QUERIES", "int", "64",
+           "held-back sample size for the recall gate"),
+    EnvVar("RAFT_TPU_COMPACT_RECALL_SLACK", "float", "0.02",
+           "gate tolerance: shadow recall may trail serving recall by at "
+           "most this"),
+    # -- observability -------------------------------------------------------
+    EnvVar("RAFT_TPU_OBS_DISABLED", "bool", "unset",
+           "1 disables span recording entirely (metrics stay on)"),
+    EnvVar("RAFT_TPU_SLOW_QUERY_MS", "float", "250",
+           "slow-query log threshold (spans over it are recorded with "
+           "their stage anatomy)"),
+    EnvVar("RAFT_TPU_SPAN_RING", "int", "512",
+           "capacity of the finished-span ring behind obs.recent_spans()"),
+    EnvVar("RAFT_TPU_FLIGHT_CAP", "int", "256",
+           "flight-recorder ring size (batch + event records kept for "
+           "incident dumps)"),
+    EnvVar("RAFT_TPU_FLIGHT_DIR", "str", "system temp",
+           "where auto/manual flight dumps (JSON + Chrome trace) are "
+           "written"),
+    EnvVar("RAFT_TPU_FLIGHT_DEBOUNCE_S", "float", "60",
+           "minimum seconds between auto-dumps; suppressed triggers are "
+           "counted"),
+    EnvVar("RAFT_TPU_DISABLE_PROFILER", "bool", "unset",
+           "1 disables the Perfetto capture helper"),
+    EnvVar("RAFT_TPU_PEAK_FLOPS", "float", "per-platform",
+           "roofline FLOP/s peak for obs.cost utilization estimates"),
+    EnvVar("RAFT_TPU_PEAK_BW", "float", "per-platform",
+           "roofline bytes/s peak for obs.cost utilization estimates"),
+    # -- kernels / planners --------------------------------------------------
+    EnvVar("RAFT_TPU_PALLAS", "str", "unset",
+           "1 routes supported kernels through the Pallas "
+           "implementations (kernels.use_pallas also accepts 0/auto)"),
+    EnvVar("RAFT_TPU_HBM_BYTES", "int", "per-platform",
+           "device memory budget the planners size against"),
+    # -- process bootstrap ---------------------------------------------------
+    EnvVar("RAFT_TPU_PLATFORM", "str", "auto",
+           "force the jax platform for the raft_tpu.bench sweeps "
+           "(cpu/tpu)"),
+    EnvVar("RAFT_TPU_CACHE_DIR", "str", "~/.cache/raft_tpu/jax_cache",
+           "persistent XLA compile cache location"),
+    EnvVar("RAFT_TPU_NO_COMPILE_CACHE", "bool", "unset",
+           "1 disables the persistent compile cache"),
+    EnvVar("RAFT_TPU_COORDINATOR", "str", "unset",
+           "multi-process jax distributed coordinator address"),
+    EnvVar("RAFT_TPU_NUM_PROCS", "int", "unset",
+           "multi-process jax distributed process count"),
+    EnvVar("RAFT_TPU_PROC_ID", "int", "unset",
+           "multi-process jax distributed process index"),
+    # -- bench harness -------------------------------------------------------
+    EnvVar("RAFT_TPU_BENCH_RECORD", "str", "BENCH_last.json",
+           "bench record artifact path (- suppresses)"),
+    EnvVar("RAFT_TPU_BENCH_PIPELINE_DEPTHS", "str", "1,2,4",
+           "depth ladder for the bench.py serve pipeline A/B"),
+    EnvVar("RAFT_TPU_BENCH_DEVICE_MS", "float", "10",
+           "paced device interval for the serve A/B's async-device model"),
+    EnvVar("RAFT_TPU_BENCH_N", "int", "500000",
+           "accelerator bench corpus size"),
+    EnvVar("RAFT_TPU_BENCH_DEADLINE_S", "float", "1500",
+           "accelerator bench leg wall-clock budget"),
+    EnvVar("RAFT_TPU_BENCH_CPU_DEADLINE_S", "float", "600",
+           "CPU bench leg wall-clock budget"),
+    # -- test harness --------------------------------------------------------
+    EnvVar("RAFT_TPU_RUN_SLOW", "bool", "unset",
+           "1 opts into @pytest.mark.slow tests (bench smokes, scale "
+           "runs)"),
+    EnvVar("RAFT_TPU_TEST_DEVICE", "bool", "unset",
+           "1 enables the on-device test assertions"),
+    EnvVar("RAFT_TPU_SCALE_N", "int", "test default",
+           "corpus size override for the scale test suite"),
+)
+
+_KNOWN: Dict[str, EnvVar] = {v.name: v for v in KNOWN_VARS}
+
+#: values env_bool reads as False when the variable IS set; anything
+#: else set is True.  README rows say "1 enables" — but operators write
+#: true/yes/on, and an explicit 0/false must mean off, not on.
+_FALSY = frozenset({"", "0", "false", "no", "off"})
+
+
+class UnknownEnvVarError(KeyError):
+    """A read of a ``RAFT_TPU_*`` name missing from :data:`KNOWN_VARS`."""
+
+
+def _declared(name: str, kind: str) -> EnvVar:
+    var = _KNOWN.get(name)
+    if var is None:
+        raise UnknownEnvVarError(
+            f"{name} is not declared in raft_tpu.core.env.KNOWN_VARS; "
+            "add a row (and a README env-table entry) before reading it"
+        )
+    if var.kind != kind:
+        raise TypeError(
+            f"{name} is declared as {var.kind!r} but read as {kind!r}; "
+            "fix the accessor or the KNOWN_VARS row"
+        )
+    return var
+
+
+def known(name: str) -> bool:
+    """Whether ``name`` is a declared knob (registry membership)."""
+    return name in _KNOWN
+
+
+def has(name: str) -> bool:
+    """Whether the declared knob ``name`` is set in the environment."""
+    if name not in _KNOWN:
+        raise UnknownEnvVarError(
+            f"{name} is not declared in raft_tpu.core.env.KNOWN_VARS"
+        )
+    return name in os.environ
+
+
+def raw(name: str) -> Optional[str]:
+    """The raw string value of a declared knob, ``None`` when unset.
+
+    For save/restore around a scoped override (the bench A/B legs flip
+    ``RAFT_TPU_PALLAS`` per case) where unset-vs-empty must round-trip.
+    """
+    if name not in _KNOWN:
+        raise UnknownEnvVarError(
+            f"{name} is not declared in raft_tpu.core.env.KNOWN_VARS"
+        )
+    return os.environ.get(name)
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    _declared(name, "str")
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    _declared(name, "int")
+    value = os.environ.get(name)
+    if value is None or not value.strip():
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        raise ValueError(f"{name}={value!r} is not an integer") from None
+
+
+def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    _declared(name, "float")
+    value = os.environ.get(name)
+    if value is None or not value.strip():
+        return default
+    try:
+        return float(value)
+    except ValueError:
+        raise ValueError(f"{name}={value!r} is not a number") from None
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    _declared(name, "bool")
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    return value.strip().lower() not in _FALSY

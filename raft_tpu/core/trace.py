@@ -22,10 +22,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 from typing import Callable, Optional, TypeVar
 
 import jax
+
+from raft_tpu.core import env as _env
 
 DOMAIN = "raft_tpu"
 
@@ -93,7 +94,7 @@ def profile(log_dir: str, *, host_tracer_level: int = 2):
     flag — here a no-op if RAFT_TPU_DISABLE_PROFILER is set).  The
     span-integrated variant lives at :func:`raft_tpu.obs.profile`.
     """
-    if os.environ.get("RAFT_TPU_DISABLE_PROFILER"):
+    if _env.env_bool("RAFT_TPU_DISABLE_PROFILER"):
         yield
         return
     with jax.profiler.trace(log_dir):

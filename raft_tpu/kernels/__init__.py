@@ -17,9 +17,9 @@ Dispatch: ``use_pallas()`` consults RAFT_TPU_PALLAS:
 
 from __future__ import annotations
 
-import os
-
 import jax
+
+from raft_tpu.core import env as _env
 
 
 def _platform() -> str:
@@ -27,7 +27,7 @@ def _platform() -> str:
 
 
 def use_pallas() -> bool:
-    mode = os.environ.get("RAFT_TPU_PALLAS", "auto")
+    mode = _env.env_str("RAFT_TPU_PALLAS", "auto")
     if mode == "0":
         return False
     if mode == "1":

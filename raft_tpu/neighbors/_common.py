@@ -383,11 +383,11 @@ def pallas_scan_enabled(
     kernels/ivf_scan.pack_list_filter). ``allow_int8`` admits the
     quantized scan cache (ivf_pq only — the kernel's int8 leg dequantizes
     by scan_scale, which raw int8/uint8 ivf_flat datasets don't have)."""
-    import os
+    from raft_tpu.core import env as _env
 
     dtypes = (jnp.float32, jnp.bfloat16) + ((jnp.int8,) if allow_int8 else ())
     return (
-        os.environ.get("RAFT_TPU_PALLAS") == "1"
+        _env.env_str("RAFT_TPU_PALLAS") == "1"
         and metric in ("sqeuclidean", "euclidean", "inner_product", "cosine")
         and storage_dtype in dtypes
     )

@@ -165,11 +165,11 @@ def knn(
     # selection so the [n_q, n] score matrix never reaches HBM). Opt-in via
     # RAFT_TPU_PALLAS=1 until the on-chip A/B vs the XLA formulation is
     # recorded (bench/prims); interpret mode keeps it testable on CPU.
-    import os as _os
+    from raft_tpu.core import env as _env
 
     canonical_f32 = dataset.dtype == jnp.float32 and queries.dtype == jnp.float32
     if (
-        _os.environ.get("RAFT_TPU_PALLAS") == "1"
+        _env.env_str("RAFT_TPU_PALLAS") == "1"
         and canonical in ("sqeuclidean", "euclidean", "inner_product")
         and k <= 128
         and canonical_f32

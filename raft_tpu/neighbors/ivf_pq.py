@@ -104,17 +104,11 @@ def _device_memory_budget() -> tuple[int, bool]:
     is a *real* reported limit (TPU/GPU ``memory_stats`` or the
     ``RAFT_TPU_HBM_BYTES`` override) as opposed to the 16 GiB (one v5e
     chip) assumption used when the backend reports nothing (e.g. CPU)."""
-    import os
+    from raft_tpu.core import env as _env
 
-    env = os.environ.get("RAFT_TPU_HBM_BYTES")
-    if env:
-        try:
-            return int(env), True
-        except ValueError as e:
-            raise ValueError(
-                f"RAFT_TPU_HBM_BYTES must be an integer byte count, got "
-                f"{env!r}"
-            ) from e
+    hbm = _env.env_int("RAFT_TPU_HBM_BYTES")
+    if hbm is not None:
+        return hbm, True
     try:
         stats = jax.local_devices()[0].memory_stats()
         if stats and stats.get("bytes_limit"):

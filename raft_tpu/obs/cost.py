@@ -35,11 +35,11 @@ cannot answer degrades to absent gauges, not to a crashed warmup.
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
+from raft_tpu.core import env as _env
 from raft_tpu.core.logger import child as _child_logger
 from raft_tpu.obs.registry import MetricsRegistry, default_registry
 
@@ -66,8 +66,8 @@ def device_peaks(platform: Optional[str] = None) -> Tuple[float, float]:
         except Exception:  # no backend at all — fall through to cpu row
             platform = "cpu"
     flops, bw = DEFAULT_PEAKS.get(platform, DEFAULT_PEAKS["cpu"])
-    flops = float(os.environ.get("RAFT_TPU_PEAK_FLOPS", flops))
-    bw = float(os.environ.get("RAFT_TPU_PEAK_BW", bw))
+    flops = _env.env_float("RAFT_TPU_PEAK_FLOPS", flops)
+    bw = _env.env_float("RAFT_TPU_PEAK_BW", bw)
     return flops, bw
 
 

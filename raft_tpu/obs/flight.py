@@ -41,6 +41,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 import raft_tpu.obs.spans as _spans
+from raft_tpu.core import env as _env
 from raft_tpu.obs.registry import default_registry
 
 #: default ring capacity (batch records)
@@ -61,22 +62,22 @@ def next_request_id() -> int:
 
 def _env_cap() -> int:
     try:
-        return max(1, int(os.environ.get("RAFT_TPU_FLIGHT_CAP", DEFAULT_CAP)))
+        return max(1, _env.env_int("RAFT_TPU_FLIGHT_CAP", DEFAULT_CAP))
     except ValueError:
         return DEFAULT_CAP
 
 
 def _env_debounce_s() -> float:
     try:
-        return max(0.0, float(
-            os.environ.get("RAFT_TPU_FLIGHT_DEBOUNCE_S", DEFAULT_DEBOUNCE_S)
+        return max(0.0, _env.env_float(
+            "RAFT_TPU_FLIGHT_DEBOUNCE_S", DEFAULT_DEBOUNCE_S
         ))
     except ValueError:
         return DEFAULT_DEBOUNCE_S
 
 
 def _env_dir() -> str:
-    return os.environ.get("RAFT_TPU_FLIGHT_DIR") or tempfile.gettempdir()
+    return _env.env_str("RAFT_TPU_FLIGHT_DIR") or tempfile.gettempdir()
 
 
 class FlightRecorder:

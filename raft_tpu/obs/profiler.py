@@ -11,9 +11,9 @@ no-op the capture without touching call sites.
 from __future__ import annotations
 
 import contextlib
-import os
 from typing import Iterator
 
+from raft_tpu.core import env as _env
 from raft_tpu.obs import spans as _spans
 from raft_tpu.obs.registry import default_registry
 
@@ -27,7 +27,7 @@ def profile(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
     ``trace_range``-wrapped call inside shows as a named host range;
     device ops carry the matching ``jax.named_scope`` labels.
     """
-    if os.environ.get("RAFT_TPU_DISABLE_PROFILER"):
+    if _env.env_bool("RAFT_TPU_DISABLE_PROFILER"):
         yield
         return
     import jax

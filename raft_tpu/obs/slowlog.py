@@ -18,12 +18,12 @@ a hot-path compile.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from raft_tpu.core import env as _env
 from raft_tpu.core.logger import child as _child_logger
 from raft_tpu.obs.registry import default_registry
 from raft_tpu.obs.spans import Span
@@ -32,7 +32,7 @@ _CAP = 256
 
 _lock = threading.Lock()
 _entries: deque = deque(maxlen=_CAP)
-_threshold_s = float(os.environ.get("RAFT_TPU_SLOW_QUERY_MS", "250")) * 1e-3
+_threshold_s = _env.env_float("RAFT_TPU_SLOW_QUERY_MS", 250.0) * 1e-3
 
 
 def configure(threshold_ms: Optional[float]) -> None:

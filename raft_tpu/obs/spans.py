@@ -23,18 +23,18 @@ from __future__ import annotations
 
 import contextlib
 import itertools
-import os
 import threading
 import time
 from collections import deque
 from typing import Dict, Iterator, List, Optional
 
+from raft_tpu.core import env as _env
 from raft_tpu.obs.registry import default_registry
 
 def _ring_cap() -> int:
     """Recent-span ring capacity: ``RAFT_TPU_SPAN_RING``, default 512."""
     try:
-        return max(1, int(os.environ.get("RAFT_TPU_SPAN_RING", "512")))
+        return max(1, _env.env_int("RAFT_TPU_SPAN_RING", 512))
     except ValueError:
         return 512
 
@@ -45,7 +45,7 @@ _recent_lock = threading.Lock()
 #: ring of recently finished root spans (tests / debugging / slow log)
 _recent: deque = deque(maxlen=_ring_cap())
 
-_disabled = bool(os.environ.get("RAFT_TPU_OBS_DISABLED"))
+_disabled = _env.env_bool("RAFT_TPU_OBS_DISABLED", False)
 
 
 def set_enabled(enabled: bool) -> None:

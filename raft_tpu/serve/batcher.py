@@ -52,7 +52,6 @@ holds samples past the batch's lifetime.
 from __future__ import annotations
 
 import itertools
-import os
 import queue as queue_mod
 import threading
 import time
@@ -63,6 +62,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from raft_tpu.core import env as _env
 from raft_tpu.core.trace import trace_range
 from raft_tpu.obs import flight, slowlog, spans
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
@@ -185,14 +185,10 @@ class MicroBatcher:
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.observer = observer
         if cost_accounting is None:
-            cost_accounting = os.environ.get(
-                "RAFT_TPU_COST_ACCOUNTING", "1"
-            ) != "0"
+            cost_accounting = _env.env_bool("RAFT_TPU_COST_ACCOUNTING", True)
         self.cost_accounting = bool(cost_accounting)
         if pipeline_depth is None:
-            pipeline_depth = int(
-                os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2")
-            )
+            pipeline_depth = _env.env_int("RAFT_TPU_PIPELINE_DEPTH", 2)
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {pipeline_depth}"
@@ -304,7 +300,8 @@ class MicroBatcher:
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return
-        self._stopping = False
+        with self._cond:
+            self._stopping = False
         self._thread = threading.Thread(
             target=self._worker, name="raft-tpu-serve-batcher", daemon=True
         )
@@ -554,8 +551,10 @@ class MicroBatcher:
                 # dispatch: host-side tracing + enqueue of the executable
                 dist, ids = self._search_fn(jax.numpy.asarray(padded))
                 t1 = time.perf_counter()
-                # device: waiting for the result to materialize
-                jax.block_until_ready((dist, ids))
+                # device: waiting for the result to materialize — the serial
+                # path's one intended sync (the pipelined path moves it to
+                # the completion thread)
+                jax.block_until_ready((dist, ids))  # raft-tpu: ignore[HOSTSYNC] serial-path batch barrier
                 t2 = time.perf_counter()
                 if sp is not None:
                     sp.add_stage("queue", max(queue_waits, default=0.0))
@@ -563,8 +562,8 @@ class MicroBatcher:
                     sp.add_stage("dispatch", t1 - t0)
                     sp.add_stage("device", t2 - t1)
             compiles = compile_count(thread=True) - c0
-            dist = np.asarray(dist)
-            ids = np.asarray(ids)
+            dist = np.asarray(dist)  # raft-tpu: ignore[HOSTSYNC] staged copy-out after the barrier
+            ids = np.asarray(ids)  # raft-tpu: ignore[HOSTSYNC] staged copy-out after the barrier
         except Exception as exc:  # noqa: BLE001 — fail the waiting futures
             self._record_flight(
                 seq=seq, batch=batch, n=n, bucket=bucket,
@@ -784,10 +783,13 @@ class MicroBatcher:
         batch = rec.batch
         t3 = time.perf_counter()
         try:
-            jax.block_until_ready((rec.dist, rec.ids))
+            # the pipelined path's intended sync point: the completion
+            # thread blocks on the oldest in-flight batch off the dispatch
+            # path, then copies results out
+            jax.block_until_ready((rec.dist, rec.ids))  # raft-tpu: ignore[HOSTSYNC] completion-thread batch barrier
             t4 = time.perf_counter()
-            dist = np.asarray(rec.dist)
-            ids = np.asarray(rec.ids)
+            dist = np.asarray(rec.dist)  # raft-tpu: ignore[HOSTSYNC] staged copy-out after the barrier
+            ids = np.asarray(rec.ids)  # raft-tpu: ignore[HOSTSYNC] staged copy-out after the barrier
         except Exception as exc:  # noqa: BLE001 — fail only this batch
             spans.finish_span(rec.sp)
             self._record_flight(

@@ -48,7 +48,6 @@ structure *off* the serving path:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import weakref
@@ -59,6 +58,7 @@ import jax
 import numpy as np
 
 from raft_tpu import obs
+from raft_tpu.core import env as _env
 from raft_tpu.core.logger import child as _child_logger
 from raft_tpu.core.trace import trace_range, traced
 from raft_tpu.distance import DISTANCE_TYPES
@@ -78,16 +78,6 @@ def reset() -> None:
             c.stop()
         except Exception:  # pragma: no cover - teardown best effort
             pass
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    return default if raw in (None, "") else float(raw)
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    return default if raw in (None, "") else int(raw)
 
 
 @dataclass(frozen=True)
@@ -122,21 +112,23 @@ class CompactionPolicy:
     def from_env(cls) -> "CompactionPolicy":
         """Policy with every field overridable via ``RAFT_TPU_COMPACT_*``."""
         return cls(
-            max_side_rows=_env_int("RAFT_TPU_COMPACT_MAX_SIDE_ROWS", 1024),
-            max_tombstone_frac=_env_float(
+            max_side_rows=_env.env_int("RAFT_TPU_COMPACT_MAX_SIDE_ROWS", 1024),
+            max_tombstone_frac=_env.env_float(
                 "RAFT_TPU_COMPACT_MAX_TOMBSTONE_FRAC", 0.25
             ),
-            interval_s=_env_float("RAFT_TPU_COMPACT_INTERVAL_S", 2.0),
-            cooldown_s=_env_float("RAFT_TPU_COMPACT_COOLDOWN_S", 30.0),
-            headroom_frac=_env_float("RAFT_TPU_COMPACT_HEADROOM_FRAC", 4.0),
-            chunk_rows=_env_int("RAFT_TPU_COMPACT_CHUNK_ROWS", 65536),
-            gate_queries=_env_int("RAFT_TPU_COMPACT_GATE_QUERIES", 64),
-            recall_slack=_env_float("RAFT_TPU_COMPACT_RECALL_SLACK", 0.02),
+            interval_s=_env.env_float("RAFT_TPU_COMPACT_INTERVAL_S", 2.0),
+            cooldown_s=_env.env_float("RAFT_TPU_COMPACT_COOLDOWN_S", 30.0),
+            headroom_frac=_env.env_float(
+                "RAFT_TPU_COMPACT_HEADROOM_FRAC", 4.0
+            ),
+            chunk_rows=_env.env_int("RAFT_TPU_COMPACT_CHUNK_ROWS", 65536),
+            gate_queries=_env.env_int("RAFT_TPU_COMPACT_GATE_QUERIES", 64),
+            recall_slack=_env.env_float("RAFT_TPU_COMPACT_RECALL_SLACK", 0.02),
         )
 
     @staticmethod
     def disabled_by_env() -> bool:
-        return os.environ.get("RAFT_TPU_COMPACT_DISABLED", "") not in ("", "0")
+        return _env.env_bool("RAFT_TPU_COMPACT_DISABLED", False)
 
 
 @dataclass

@@ -53,6 +53,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu import obs
 from raft_tpu.comms.comms import Comms, local_comms
+from raft_tpu.core import env as _env
 from raft_tpu.core.bitset import Bitset, WORD_BITS
 from raft_tpu.core.compat import shard_map
 from raft_tpu.core.trace import trace_range
@@ -74,7 +75,7 @@ _MERGE_DTYPES = {
 
 def merge_dtype_from_env() -> Optional[jnp.dtype]:
     """Resolve ``RAFT_TPU_SHARD_MERGE_DTYPE`` to a cast dtype (or None)."""
-    name = os.environ.get(MERGE_DTYPE_ENV, "float32").strip().lower()
+    name = _env.env_str(MERGE_DTYPE_ENV, "float32").strip().lower()
     if name not in _MERGE_DTYPES:
         raise ValueError(
             f"{MERGE_DTYPE_ENV}={name!r} not understood; expected one of "

@@ -1,0 +1,10 @@
+"""Seeded-bug fixture package for the raft_tpu.analysis checkers.
+
+Every rule (RECOMPILE, HOSTSYNC, LOCKORDER, ENVREG, TRACED) has at
+least one deliberately planted violation here, plus a suppressed
+duplicate proving ``# raft-tpu: ignore[RULE]`` is honored.  The layout
+mirrors the real package (``serve/batcher.py``, ``neighbors/...``) so
+the suffix-matched contracts — hot-path roots, serve span labels, the
+batcher plumbing — fire on the same shapes they guard in production.
+Never imported at runtime; the analyzer only parses it.
+"""

@@ -1,0 +1,6 @@
+"""ENVREG seed: a stray literal env read outside core/env.py."""
+
+import os
+
+CAP = int(os.environ.get("RAFT_TPU_FIXTURE_CAP", "8"))
+DIR = os.environ.get("RAFT_TPU_FIXTURE_DIR")  # raft-tpu: ignore[ENVREG] suppression control

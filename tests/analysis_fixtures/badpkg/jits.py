@@ -1,0 +1,33 @@
+"""RECOMPILE seeds: value-dependent control flow under jit."""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def gate(x, k):
+    if k > 0:  # branches on the value of traced k
+        return x
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def gate_static(x, k):
+    if k > 0:  # negative control: k is static, no finding
+        return x
+    return x * 2
+
+
+@jax.jit
+def concretize(x):
+    return int(x)  # raft-tpu: ignore[RECOMPILE] suppression control
+
+
+def make_adder():
+    extras = []
+
+    def inner(x):
+        return x + len(extras)
+
+    return jax.jit(inner)  # closure captures a mutable list

@@ -1,0 +1,5 @@
+"""TRACED seed surface: exported backend module with untraced entries."""
+
+from badpkg.neighbors import flat
+
+__all__ = ["flat"]

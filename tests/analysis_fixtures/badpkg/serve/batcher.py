@@ -1,0 +1,52 @@
+"""LOCKORDER / HOSTSYNC / TRACED seeds on the batcher shape."""
+
+import threading
+
+
+class _Request:
+    __slots__ = ("rows", "fut")  # dropped req_id
+
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._pending = 0
+
+    def ordered(self):
+        with self._lock:
+            with self._cond:
+                self._pending = 1
+
+    def reversed_order(self):
+        with self._cond:
+            with self._lock:  # opposite nesting: acquisition cycle
+                pass
+
+    def bump(self):
+        self._pending += 1  # guarded attr written without the lock
+
+    def bump_quietly(self):
+        self._pending -= 1  # raft-tpu: ignore[LOCKORDER] suppression control
+
+    def _dispatch_locked(self, batch):
+        vals = batch.dist.item()  # hot-path device sync
+        ok = batch.ids.tolist()  # raft-tpu: ignore[HOSTSYNC] suppression control
+        self._record_flight(batch)
+        return vals, ok
+
+    def _dispatch_pipelined(self, batch):
+        # no open_span / finish_span: detached-span plumbing dropped
+        return self._dispatch_locked(batch)
+
+    def _complete(self, rec):  # raft-tpu: ignore[TRACED] suppression control
+        self._record_flight(rec)
+        return rec
+
+    def submit(self, rows):
+        # no next_request_id / request_id: anonymous batches
+        return rows
+
+    def _record_flight(self, rec):
+        # no req_id: member request ids never reach the records
+        return rec

@@ -1,0 +1,20 @@
+"""TRACED seeds on the serve online surface."""
+
+from badpkg.core.trace import traced  # resolved by name only, never run
+
+
+class SearchService:
+    def search(self, queries):
+        return queries  # lacks @traced("serve.search")
+
+    @traced("serve.swap")
+    def swap(self, index):
+        return index
+
+    @traced("serve.warmup")
+    def warmup(self):
+        return None
+
+    @traced("serve.warmup")  # wrong label for flush + duplicate label
+    def flush(self):
+        return None

@@ -221,7 +221,7 @@ class TestIncidentTriggers:
         # batcher must treat that as a shape leak and capture the ring
         fake = {"n": 0}
 
-        def fake_compile_count():
+        def fake_compile_count(thread=False):
             fake["n"] += 1
             return fake["n"]
 

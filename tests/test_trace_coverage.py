@@ -1,209 +1,64 @@
-"""Static observability-coverage check: every public entry point that
-``raft_tpu.neighbors`` / ``raft_tpu.cluster`` export must be wrapped with
-``@traced`` — new APIs can't ship unobservable.
+"""Observability-coverage contract, enforced by the TRACED checker.
 
-The contract: a function exported directly in a package ``__all__``, or a
-canonical entry-point name (build/search/fit/...) inside an exported
-backend module, carries the ``__traced__`` marker that
-``raft_tpu.core.trace.traced`` stamps on its wrappers.  This is what keeps
-the obs story zero-churn — spans exist because the decorator is there, so
-this test is the enforcement end of the tentpole.
-
-The serve surface is covered explicitly (methods, not module functions):
-the online entry points — ``SearchService.search/swap/warmup``,
-``MutableIndex.upsert/delete`` — must report spans too, with unique
-labels, or a serving latency excursion has no span to decompose into.
+This file used to introspect the imported packages at runtime
+(``__traced__`` markers stamped by ``core.trace.traced``, plus
+``inspect.getsource`` greps over the batcher).  That whole contract now
+lives in :mod:`raft_tpu.analysis.checkers.traced` as a static check —
+exported ``neighbors``/``cluster`` entry points must carry ``@traced``,
+the serve online surface must carry exact unique span labels, and the
+pipelined dispatch path must keep its detached-span and request-id
+plumbing.  This test is the thin wrapper: run the checker over the real
+package, assert discovery saw the API surface (not vacuous), and assert
+zero findings.  The per-rule behaviour of the checker itself (that it
+*fires* on violations and honors suppressions) is covered by
+``tests/test_static_analysis.py`` against the seeded fixture package.
 """
 
-import inspect
+import os
 
 import pytest
 
-import raft_tpu.cluster
-import raft_tpu.neighbors
-import raft_tpu.serve
-
-#: canonical entry-point names inside exported backend modules.  A helper
-#: named anything else is free to stay untraced; anything on this list is
-#: user-facing API surface and must report spans.
-ENTRY_NAMES = {
-    "build",
-    "build_batch",
-    "search",
-    "extend",
-    "knn",
-    "knn_query",
-    "all_knn_query",
-    "eps_nn",
-    "fit",
-    "predict",
-    "fit_predict",
-    "transform",
-    "save",
-    "load",
-    "serialize_to_hnswlib",
-}
-
-PACKAGES = (raft_tpu.neighbors, raft_tpu.cluster)
+import raft_tpu
+from raft_tpu.analysis import run_analysis
+from raft_tpu.analysis.checkers import traced as traced_checker
+from raft_tpu.analysis.model import Project
 
 
-def _entry_points():
-    """Yield (dotted_name, function) for every public entry point."""
-    for pkg in PACKAGES:
-        for export in pkg.__all__:
-            obj = getattr(pkg, export)
-            if inspect.isfunction(obj):
-                yield f"{pkg.__name__}.{export}", obj
-            elif inspect.ismodule(obj):
-                for fn_name, fn in vars(obj).items():
-                    if (
-                        not fn_name.startswith("_")
-                        and fn_name in ENTRY_NAMES
-                        and inspect.isfunction(fn)
-                        and fn.__module__.startswith("raft_tpu")
-                    ):
-                        yield f"{obj.__name__}.{fn_name}", fn
+@pytest.fixture(scope="module")
+def project():
+    return Project(os.path.dirname(raft_tpu.__file__))
 
 
-def test_entry_point_discovery_is_not_vacuous():
-    names = [n for n, _ in _entry_points()]
-    # the suite must actually see the API surface — a refactor that breaks
-    # discovery would otherwise green-light everything
+@pytest.fixture(scope="module")
+def result():
+    return run_analysis(rules=["TRACED"])
+
+
+def test_entry_point_discovery_is_not_vacuous(project):
+    names = sorted(traced_checker._api_entry_points(project))
+    # the checker must actually see the API surface — a refactor that
+    # breaks discovery would otherwise green-light everything
     assert len(names) >= 25, names
     for expected in (
         "raft_tpu.neighbors.brute_force.search",
         "raft_tpu.neighbors.ivf_pq.build",
         "raft_tpu.neighbors.hnsw.search",
-        "raft_tpu.cluster.fit",
+        "raft_tpu.cluster.kmeans.fit",
     ):
         assert expected in names, f"{expected} not discovered"
 
 
-def test_every_entry_point_is_traced():
-    missing = sorted(
-        name
-        for name, fn in _entry_points()
-        if not getattr(fn, "__traced__", None)
+def test_serve_surface_discovery_is_not_vacuous(result):
+    # all nine online entry points (service/mutation/compactor) checked,
+    # against exactly one MicroBatcher
+    assert result.stats["traced_serve_entries_checked"] == 9, result.stats
+    assert result.stats["traced_batcher_classes"] == 1, result.stats
+    assert result.stats["traced_labels"] >= 20, result.stats
+
+
+def test_trace_coverage_is_clean(result):
+    rendered = "\n".join(f.render() for f in result.sorted_findings())
+    assert not result.findings, (
+        "TRACED contract violations (untraced entry point, wrong/duplicate "
+        f"span label, or dropped batcher plumbing):\n{rendered}"
     )
-    assert not missing, (
-        "entry points without @traced (add the decorator so the obs "
-        f"registry sees them): {missing}"
-    )
-
-
-#: online (method) entry points and the span label each must carry —
-#: additions to the serve API surface belong on this list
-SERVE_ENTRY_POINTS = {
-    "SearchService.search": "serve.search",
-    "SearchService.swap": "serve.swap",
-    "SearchService.warmup": "serve.warmup",
-    "SearchService.flush": "serve.flush",
-    "MutableIndex.upsert": "serve.upsert",
-    "MutableIndex.delete": "serve.delete",
-    "Compactor.compact": "serve.compact",
-    "Compactor.promote": "serve.compact.promote",
-    "Compactor.abort": "serve.compact.abort",
-}
-
-
-def _serve_methods():
-    for dotted, label in SERVE_ENTRY_POINTS.items():
-        cls_name, meth_name = dotted.split(".")
-        cls = getattr(raft_tpu.serve, cls_name)
-        yield dotted, getattr(cls, meth_name), label
-
-
-def test_serve_entry_points_are_traced():
-    missing = sorted(
-        dotted
-        for dotted, fn, _ in _serve_methods()
-        if not getattr(fn, "__traced__", None)
-    )
-    assert not missing, (
-        "serve entry points without @traced (online latency excursions "
-        f"would have no span to decompose): {missing}"
-    )
-
-
-def test_pipelined_dispatch_reports_detached_spans():
-    """The pipelined dispatch path cannot use ``@traced``/``trace_range``
-    (its ``serve.batch`` span opens on the dispatch thread and closes on
-    the completion thread, and thread-local span stacks don't cross), so
-    enforce the detached-span calls by source inspection: opened at
-    dispatch, finished on the completion path AND on both failure paths —
-    a dropped span would leak one unfinished record per failed batch."""
-    from raft_tpu.serve.batcher import MicroBatcher
-
-    dispatch_src = inspect.getsource(MicroBatcher._dispatch_pipelined)
-    complete_src = inspect.getsource(MicroBatcher._complete)
-    assert "open_span" in dispatch_src, (
-        "_dispatch_pipelined no longer opens the detached serve.batch span"
-    )
-    assert "finish_span" in dispatch_src, (
-        "_dispatch_pipelined's failure path must close the span it opened"
-    )
-    assert "finish_span" in complete_src, (
-        "_complete must close the detached span (success and failure)"
-    )
-
-
-def test_request_ids_propagate_through_serve_entry_points():
-    """Static enforcement of the request-id thread: every request gets a
-    process-wide id at submit, and both dispatch paths must hand the
-    member ids to the flight recorder, the metrics exemplars and the slow
-    log.  A refactor that drops any link silently reverts serving to
-    anonymous batches — aggregates with no way back to the request."""
-    from raft_tpu.serve.batcher import MicroBatcher, _Request
-
-    submit_src = inspect.getsource(MicroBatcher.submit)
-    assert "next_request_id" in submit_src, (
-        "MicroBatcher.submit no longer assigns flight.next_request_id"
-    )
-    assert "request_id" in submit_src, (
-        "MicroBatcher.submit must expose the id as fut.request_id"
-    )
-    assert "req_id" in _Request.__slots__, (
-        "_Request dropped its req_id slot; ids cannot cross the queue"
-    )
-    for path in (MicroBatcher._dispatch_locked, MicroBatcher._complete):
-        src = inspect.getsource(path)
-        assert "_record_flight" in src, (
-            f"{path.__name__} no longer feeds the flight recorder"
-        )
-        assert "request_ids" in src, (
-            f"{path.__name__} dropped request ids from its records"
-        )
-    record_src = inspect.getsource(MicroBatcher._record_flight)
-    assert "req.req_id" in record_src, (
-        "_record_flight must carry member request ids into batch records"
-    )
-
-
-def test_serve_traced_labels_match_and_are_unique():
-    seen = {}
-    for dotted, fn, expected in _serve_methods():
-        label = getattr(fn, "__traced__", None)
-        assert label == expected, (
-            f"{dotted} carries span label {label!r}, expected {expected!r}"
-        )
-        assert label not in seen, (
-            f"span label {label!r} reused by {seen[label]} and {dotted}"
-        )
-        seen[label] = dotted
-
-
-@pytest.mark.parametrize("pkg", PACKAGES, ids=lambda p: p.__name__)
-def test_traced_labels_are_unique_per_package(pkg):
-    """Two entry points sharing a span label would merge their latency
-    histograms into one unreadable series."""
-    labels = {}
-    for name, fn in _entry_points():
-        if not name.startswith(pkg.__name__):
-            continue
-        label = getattr(fn, "__traced__", None)
-        if label is None:
-            continue
-        assert labels.get(label, name) == name, (
-            f"span label {label!r} reused by {labels[label]} and {name}"
-        )
-        labels[label] = name
