@@ -179,6 +179,9 @@ def main() -> None:
     if "flight" in sys.argv[1:]:
         run_flight_leg()
         return
+    if "slo" in sys.argv[1:]:
+        run_slo_leg()
+        return
     if "analyze" in sys.argv[1:]:
         run_analyze_leg()
         return
@@ -845,6 +848,186 @@ def run_flight_leg() -> None:
             "pipeline_depth": depth,
             "recorder_on": on,
             "recorder_off": off,
+            "qps_ratio": ratio,
+            "overhead_pct": (
+                round((1.0 - ratio) * 100.0, 2) if ratio else None
+            ),
+            "recompiles": on["recompiles"] + off["recompiles"],
+            "requests": n_requests,
+            "n": n,
+        }
+    )
+
+
+def run_slo_leg() -> None:
+    """``python bench.py slo`` — SLO-engine overhead A/B (CPU).
+
+    Same paced-device serve workload as ``run_flight_leg`` at pipeline
+    depth 2, run as ``RAFT_TPU_BENCH_SLO_ROUNDS`` (default 3)
+    interleaved off/on rounds: each round serves once with no SLO
+    engine and once with a :class:`raft_tpu.obs.slo.SloEngine`
+    evaluating the availability and latency objectives for the served
+    name on a deliberately aggressive 200 ms tick (50x faster than the
+    production default; on the single-core CI host each evaluator wake
+    preempts the serving core, so the tick rate IS the overhead — 50x
+    is the honest worst case that still meets the <2% bar there, and
+    multi-core hosts run the evaluator on a spare core for ~0%).  The
+    headline ratio pools total requests over total
+    wall per arm kind, because on a single-core CI host one off/on pair
+    swings +-10% with scheduler noise.  The evaluator reads cumulative
+    counters and histogram bucket totals off the hot path (never the
+    raw reservoirs — see ``Histogram.bucket_totals``); the acceptance
+    bar is <2% QPS overhead, gated by ``bench.py compare`` against the
+    frozen record in ``benchmarks/``.
+    """
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import slo, slowlog
+    from raft_tpu.serve.batcher import MicroBatcher
+    from raft_tpu.serve.metrics import ServingMetrics
+
+    n, d, k = 8192, 64, 10
+    n_requests, n_clients, depth = 2048, 4, 2
+    device_ms = float(os.environ.get("RAFT_TPU_BENCH_DEVICE_MS", "10"))
+    slowlog.configure(None)  # open-loop flood: queue waits are the workload
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_requests, d), dtype=np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), dataset)
+    params = ivf_flat.SearchParams(n_probes=8)
+
+    class _Paced:
+        __slots__ = ("arr", "deadline")
+
+        def __init__(self, arr, deadline: float):
+            self.arr = arr
+            self.deadline = deadline
+
+        def block_until_ready(self):
+            jax.block_until_ready(self.arr)
+            rest = self.deadline - time.perf_counter()
+            if rest > 0:
+                time.sleep(rest)  # releases the GIL, like a TPU RPC
+            return self
+
+        def __array__(self, dtype=None):
+            a = np.asarray(self.arr)
+            return a if dtype is None else a.astype(dtype)
+
+    def make_paced_search():
+        lock = threading.Lock()
+        state = {"free": 0.0}
+
+        def search_fn(batch):
+            dist, ids = ivf_flat.search(params, index, batch, k)
+            with lock:
+                start = max(time.perf_counter(), state["free"])
+                state["free"] = deadline = start + device_ms * 1e-3
+            return _Paced(dist, deadline), _Paced(ids, deadline)
+
+        return search_fn
+
+    def _run_slo_arm(served: str, with_engine: bool) -> tuple:
+        batcher = MicroBatcher(
+            make_paced_search(), d, max_batch=32, max_delay_ms=0.5,
+            metrics=ServingMetrics(name=served),
+            pipeline_depth=depth,
+        )
+        batcher.warmup()
+        engine = None
+        if with_engine:
+            engine = slo.SloEngine(
+                [
+                    slo.SloSpec(f"{served}-availability", served,
+                                "availability", 0.999),
+                    slo.SloSpec(f"{served}-latency", served, "latency",
+                                0.9999, target=0.25),
+                ],
+                eval_s=0.2, scale=1.0,
+            )
+            engine.start()
+
+        def client(cid: int):
+            futs = [
+                batcher.submit(queries[i])
+                for i in range(cid, n_requests, n_clients)
+            ]
+            for f in futs:
+                f.result(timeout=300)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        st = batcher.metrics.snapshot()
+        out = {
+            "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+            "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+            "batches": st["batches"],
+            "recompiles": st["recompiles"],
+        }
+        if engine is not None:
+            snap = engine.snapshot()
+            out["evals"] = max(
+                s["samples"] for s in snap["specs"].values()
+            )
+            out["budget_remaining"] = round(min(
+                s["budget_remaining"] for s in snap["specs"].values()
+            ), 6)
+            engine.stop()
+        batcher.stop()
+        return wall, out
+
+    _run_slo_arm("bench_slo_warm", False)  # discarded: jit/thread warmth
+    # interleaved rounds, pooled walls: single-core CI hosts schedule
+    # the 4-client open-loop flood noisily enough that one off/on pair
+    # can swing +-10% either way — the headline ratio comes from total
+    # requests over total wall per arm kind across all rounds
+    n_rounds = int(os.environ.get("RAFT_TPU_BENCH_SLO_ROUNDS", "3"))
+    off_wall = on_wall = 0.0
+    off_recompiles = on_recompiles = 0
+    off = on = None
+    for r in range(n_rounds):
+        wall, off = _run_slo_arm(f"bench_slo_off{r}", False)
+        off_wall += wall
+        off_recompiles += off["recompiles"]
+        wall, on = _run_slo_arm(f"bench_slo_on{r}", True)
+        on_wall += wall
+        on_recompiles += on["recompiles"]
+    off["qps"] = round(n_rounds * n_requests / off_wall, 1)
+    on["qps"] = round(n_rounds * n_requests / on_wall, 1)
+    off["recompiles"], on["recompiles"] = off_recompiles, on_recompiles
+    assert on.get("evals", 0) > 0, (
+        "SLO evaluator never ticked during the measured arm"
+    )
+    assert on["budget_remaining"] > 0.0, (
+        "error budget burned on an error-free workload"
+    )
+    ratio = round(on["qps"] / off["qps"], 4) if off["qps"] else None
+    _emit(
+        {
+            "metric": f"serve_slo_engine_qps_ivf_flat_n{n // 1000}k_k{k}",
+            "value": on["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "device_ms": device_ms,
+            "pipeline_depth": depth,
+            "slo_on": on,
+            "slo_off": off,
+            "rounds": n_rounds,
             "qps_ratio": ratio,
             "overhead_pct": (
                 round((1.0 - ratio) * 100.0, 2) if ratio else None

@@ -52,6 +52,8 @@ SERVE_ENTRY_POINTS = {
     ("serve.compactor.Compactor", "compact"): "serve.compact",
     ("serve.compactor.Compactor", "promote"): "serve.compact.promote",
     ("serve.compactor.Compactor", "abort"): "serve.compact.abort",
+    ("obs.slo.SloEngine", "evaluate_once"): "slo.evaluate",
+    ("obs.incidents.IncidentManager", "handle_event"): "incidents.ingest",
 }
 
 

@@ -101,6 +101,36 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_FLIGHT_DEBOUNCE_S", "float", "60",
            "minimum seconds between auto-dumps; suppressed triggers are "
            "counted"),
+    EnvVar("RAFT_TPU_EVENTS_RING", "int", "256",
+           "obs event-bus recent-events ring capacity (overflow is "
+           "counted, never blocking)"),
+    EnvVar("RAFT_TPU_INCIDENT_WINDOW_S", "float", "5",
+           "correlation window: trigger events this close join one "
+           "incident (and share one flight dump)"),
+    EnvVar("RAFT_TPU_INCIDENT_AUTOCLOSE_S", "float", "30",
+           "quiet seconds after which an open incident auto-closes"),
+    EnvVar("RAFT_TPU_INCIDENT_MAX_OPEN", "int", "8",
+           "bound on simultaneously open incidents (excess triggers are "
+           "counted, not tracked)"),
+    EnvVar("RAFT_TPU_INCIDENT_DIR", "str", "flight dir",
+           "where closed-incident JSON + Chrome-trace exports are "
+           "written"),
+    EnvVar("RAFT_TPU_SLO_WINDOW_SCALE", "float", "1.0",
+           "scales every SLO window (eval period, burn windows, budget "
+           "window) — tests shrink hours to milliseconds"),
+    EnvVar("RAFT_TPU_SLO_EVAL_S", "float", "10",
+           "SLO evaluator tick period (before window scaling)"),
+    EnvVar("RAFT_TPU_SLO_BUDGET_WINDOW_S", "float", "2592000",
+           "error-budget window (30 days, before window scaling)"),
+    EnvVar("RAFT_TPU_SLO_AVAILABILITY", "float", "0.999",
+           "default availability objective for watched indexes"),
+    EnvVar("RAFT_TPU_SLO_P99_MS", "float", "250",
+           "default latency-SLO target: requests over this are slow"),
+    EnvVar("RAFT_TPU_SLO_RECALL", "float", "0.9",
+           "default audited-recall objective for watched indexes"),
+    EnvVar("RAFT_TPU_SLO_FRESHNESS_S", "float", "300",
+           "default freshness target: max age of the oldest un-compacted "
+           "mutation"),
     EnvVar("RAFT_TPU_DISABLE_PROFILER", "bool", "unset",
            "1 disables the Perfetto capture helper"),
     EnvVar("RAFT_TPU_PEAK_FLOPS", "float", "per-platform",
@@ -134,6 +164,8 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
            "depth ladder for the bench.py serve pipeline A/B"),
     EnvVar("RAFT_TPU_BENCH_DEVICE_MS", "float", "10",
            "paced device interval for the serve A/B's async-device model"),
+    EnvVar("RAFT_TPU_BENCH_SLO_ROUNDS", "int", "3",
+           "interleaved off/on rounds pooled by the bench.py slo A/B"),
     EnvVar("RAFT_TPU_BENCH_N", "int", "500000",
            "accelerator bench corpus size"),
     EnvVar("RAFT_TPU_BENCH_DEADLINE_S", "float", "1500",

@@ -33,6 +33,16 @@ into queryable state:
   ring of recent batches with member request ids and per-request
   timelines, auto-dumped (JSON + Perfetto-loadable Chrome trace) on
   health/quality/recompile/exception incidents.
+- :mod:`~raft_tpu.obs.events` — bounded in-process pub/sub bus carrying
+  every operationally interesting edge (health transitions, quality
+  alarms, hot recompiles, batch errors, compaction lifecycle, registry
+  swaps, SLO burns); the flight auto-dump is one subscriber.
+- :mod:`~raft_tpu.obs.slo` — declarative SLOs with error budgets and
+  Google-SRE multi-window multi-burn-rate alerting over availability,
+  p99 latency, audited recall and mutation freshness.
+- :mod:`~raft_tpu.obs.incidents` — bus subscriber correlating bursts of
+  events into incident timelines with service context at open/close,
+  exported as JSON + Chrome trace alongside flight dumps.
 
 Quick start::
 
@@ -60,11 +70,24 @@ from raft_tpu.obs.export import (
     to_prometheus,
     write_snapshot,
 )
+from raft_tpu.obs.events import (
+    Event,
+    EventBus,
+    default_bus,
+    events_snapshot,
+    publish,
+    subscribe,
+)
 from raft_tpu.obs.flight import (
     FlightRecorder,
     default_recorder,
     flight_snapshot,
     next_request_id,
+)
+from raft_tpu.obs.incidents import (
+    Incident,
+    IncidentManager,
+    incidents_snapshot,
 )
 from raft_tpu.obs.profiler import profile
 from raft_tpu.obs.quality import QualityAuditor
@@ -76,6 +99,7 @@ from raft_tpu.obs.registry import (
     MetricsRegistry,
     default_registry,
 )
+from raft_tpu.obs.slo import AlertPolicy, SloEngine, SloSpec
 from raft_tpu.obs.slowlog import slowlog_snapshot
 from raft_tpu.obs.spans import (
     Span,
@@ -89,9 +113,12 @@ from raft_tpu.obs.spans import (
 )
 from raft_tpu.obs import (
     cost,
+    events,
     flight,
     health,
+    incidents,
     quality,
+    slo,
     slowlog,
     spans,
     xla_events,
@@ -101,13 +128,16 @@ registry = default_registry  # `obs.registry()` reads as the obvious accessor
 
 
 def install() -> None:
-    """Activate the full pipeline: XLA monitoring listeners plus the span
-    and slow-query sections in registry snapshots.  Idempotent."""
+    """Activate the full pipeline: XLA monitoring listeners, the span and
+    slow-query sections in registry snapshots, and the default event bus
+    (whose creation wires the flight auto-dump subscriber and the
+    incident manager).  Idempotent."""
     xla_events.install()
     reg = default_registry()
     reg.register_provider("spans", spans_snapshot)
     reg.register_provider("slow_queries", slowlog_snapshot)
     reg.register_provider("flight", flight_snapshot)
+    events.default_bus()
 
 
 def snapshot():
@@ -117,39 +147,54 @@ def snapshot():
 
 
 __all__ = [
+    "AlertPolicy",
     "CostReport",
     "Counter",
+    "Event",
+    "EventBus",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "Incident",
+    "IncidentManager",
     "LabelCardinalityError",
     "MetricsRegistry",
     "QualityAuditor",
+    "SloEngine",
+    "SloSpec",
     "Span",
     "analyze_callable",
     "analyze_compiled",
     "cost",
     "current_span",
+    "default_bus",
     "default_recorder",
     "default_registry",
+    "events",
+    "events_snapshot",
     "finish_span",
     "flight",
     "health",
+    "incidents",
+    "incidents_snapshot",
     "install",
     "next_request_id",
     "open_span",
     "profile",
+    "publish",
     "quality",
     "recent_spans",
     "record_cost",
     "refresh_live_buffer_gauges",
     "registry",
     "set_enabled",
+    "slo",
     "slowlog",
     "snapshot",
     "snapshot_json",
     "span",
     "spans",
+    "subscribe",
     "to_openmetrics",
     "to_prometheus",
     "write_snapshot",

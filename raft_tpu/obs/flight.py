@@ -11,13 +11,24 @@ it already keeps — into a bounded ring, and :func:`dump` writes both a
 JSON snapshot and a Chrome-trace-event file loadable straight into
 https://ui.perfetto.dev.
 
-Triggers (all debounced through :func:`auto_dump`, so one incident
-produces one artifact, not one per symptom):
+Triggers arrive over the :mod:`raft_tpu.obs.events` bus — the recorder
+is just one subscriber (:func:`install_bus_subscriber`, wired
+automatically when the default bus is created):
 
 - health transition to UNHEALTHY (:mod:`raft_tpu.obs.health`);
 - quality-alarm edge (:mod:`raft_tpu.obs.quality`);
 - a hot-path recompile after warmup (the batcher);
-- a batch exception on either dispatch path (the batcher).
+- a batch exception on either dispatch path (the batcher);
+- a compaction recall-gate abort, an SLO burn-rate alert.
+
+Dump suppression is two-layered: the bus subscription debounces **per
+reason** (``RAFT_TPU_FLIGHT_DEBOUNCE_S`` — a ``quality_alarm`` dump no
+longer suppresses a later unrelated ``hot_recompile``), and a short
+cross-reason correlation guard (``RAFT_TPU_INCIDENT_WINDOW_S``) keeps
+one *incident* producing one artifact even when it trips several
+symptoms back-to-back (the quality alarm fires, then the next
+``healthz()`` goes UNHEALTHY).  :func:`auto_dump` keeps the old single
+global window and survives only as a deprecated direct path.
 
 Env knobs: ``RAFT_TPU_FLIGHT_CAP`` (ring size, batch records, default
 256), ``RAFT_TPU_FLIGHT_DIR`` (auto-dump directory, default the system
@@ -186,11 +197,13 @@ class FlightRecorder:
         return path
 
     def auto_dump(self, reason: str) -> Optional[str]:
-        """Debounced :meth:`dump` for incident triggers.  One incident
-        usually trips several triggers (the quality alarm fires, then the
-        next ``healthz()`` goes UNHEALTHY); within the debounce window
-        only the first writes an artifact.  Never raises — these calls
-        sit on health/alarm/error paths that must not gain failure modes.
+        """Deprecated direct trigger path: :meth:`dump` behind one
+        *global* debounce window shared across all reasons.  In-tree
+        producers now publish :mod:`raft_tpu.obs.events` events instead
+        and the bus subscriber debounces per reason; this survives for
+        out-of-tree callers that wired incidents before the bus existed.
+        Never raises — these calls sit on health/alarm/error paths that
+        must not gain failure modes.
         """
         if not _spans.enabled():
             return None
@@ -327,3 +340,72 @@ def flight_snapshot() -> Dict[str, object]:
 
 def reset() -> None:
     _default.reset()
+    _on_bus_reset()
+
+
+# ---------------------------------------------------------------------------
+# event-bus subscriber: the migrated trigger path
+
+#: default cross-reason correlation guard (seconds) — mirrors the
+#: incident manager's grouping window so "one incident, one artifact"
+#: survives the move to per-reason debounce
+DEFAULT_CORRELATION_S = 5.0
+
+_bus_guard = threading.Lock()
+_last_bus_dump = float("-inf")   # monotonic stamp of the last bus-triggered dump
+
+
+def _env_correlation_s() -> float:
+    try:
+        return max(0.0, _env.env_float(
+            "RAFT_TPU_INCIDENT_WINDOW_S", DEFAULT_CORRELATION_S
+        ))
+    except ValueError:
+        return DEFAULT_CORRELATION_S
+
+
+def _on_bus_event(event) -> None:
+    """Dump the ring for a trigger event.  The per-reason debounce
+    already ran in the bus subscription; here only the short cross-reason
+    correlation guard applies (several symptoms of one incident within
+    ``RAFT_TPU_INCIDENT_WINDOW_S`` share the first artifact).  Never
+    raises — the bus swallows subscriber errors, but a dump failure
+    should not even count as one."""
+    global _last_bus_dump
+    if event.recovered or not _spans.enabled():
+        return
+    now = time.monotonic()
+    with _bus_guard:
+        suppressed = now - _last_bus_dump < _env_correlation_s()
+        if not suppressed:
+            _last_bus_dump = now
+    if suppressed:
+        default_registry().counter(
+            "raft_tpu_flight_dumps_suppressed_total",
+            help="auto-dumps suppressed by the debounce window",
+        ).inc(reason=event.reason)
+        return
+    try:
+        _default.dump(reason=event.reason)
+    except Exception:  # noqa: BLE001 — incident paths must not fail
+        pass
+
+
+def install_bus_subscriber(bus) -> None:
+    """Register the flight dumper on ``bus``: trigger kinds only,
+    debounced per reason with the ``RAFT_TPU_FLIGHT_DEBOUNCE_S`` window.
+    Called once per bus by :func:`raft_tpu.obs.events.default_bus`."""
+    from raft_tpu.obs import events as _events
+
+    bus.subscribe(
+        _on_bus_event,
+        kinds=_events.TRIGGER_KINDS,
+        debounce_s=_env_debounce_s(),
+        name="flight",
+    )
+
+
+def _on_bus_reset() -> None:
+    global _last_bus_dump
+    with _bus_guard:
+        _last_bus_dump = float("-inf")

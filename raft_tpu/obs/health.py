@@ -34,7 +34,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
-from raft_tpu.obs import flight
+from raft_tpu.obs import events, flight
 from raft_tpu.obs.registry import MetricsRegistry, default_registry
 
 OK = "OK"
@@ -212,16 +212,41 @@ def reset_transitions() -> None:
         _prev_overall = None
 
 
+def slo_check(slo_health: Optional[Dict[str, object]]) -> Dict[str, object]:
+    """Fold an :meth:`~raft_tpu.obs.slo.SloEngine.health` slice into a
+    health check: an exhausted error budget is DEGRADED — serving still
+    works, but the operator contract is broken and releases should
+    freeze until the budget window rolls."""
+    if not slo_health:
+        return _check(OK, "no SLOs configured")
+    exhausted = list(slo_health.get("exhausted") or ())
+    alerting = list(slo_health.get("alerting") or ())
+    if exhausted:
+        return _check(
+            DEGRADED,
+            "error budget exhausted: " + ", ".join(sorted(exhausted)),
+        )
+    if alerting:
+        return _check(
+            OK, "burn-rate alert firing: " + ", ".join(sorted(alerting))
+        )
+    return _check(OK, "budgets healthy")
+
+
 def build_report(
     probes: Dict[str, IndexProbe],
     registry: Optional[MetricsRegistry] = None,
+    slo: Optional[Dict[str, object]] = None,
 ) -> Dict[str, object]:
     """Assemble the service-wide report and publish ``raft_tpu_health``.
 
     One gauge series per index plus ``index=overall`` — the overall
-    verdict also folds in the device memory check, which is a property of
-    the process, not of any one index.  A transition *into* UNHEALTHY
-    triggers a debounced flight-recorder auto-dump, and the report's
+    verdict also folds in the device memory check (a property of the
+    process, not of any one index) and, when ``slo`` (an
+    ``SloEngine.health()`` slice) is passed, the error-budget check.  A
+    transition *into* UNHEALTHY publishes a ``health_edge`` event on the
+    obs bus (whose flight subscriber dumps the ring, debounced), the
+    transition back out publishes the recovery edge, and the report's
     ``flight`` key carries the most recent dump's paths so the healthz
     payload that announces the incident also says where the evidence is.
     """
@@ -239,16 +264,32 @@ def build_report(
         statuses.append(rep["status"])
         gauge.set(VERDICT_VALUES[rep["status"]], index=name)
     mem = device_memory_check()
+    budget = slo_check(slo) if slo is not None else None
+    if budget is not None:
+        statuses.append(budget["status"])
     overall = worst(mem["status"], *statuses)
     gauge.set(VERDICT_VALUES[overall], index="overall")
     with _transition_lock:
         went_unhealthy = overall == UNHEALTHY and _prev_overall != UNHEALTHY
+        recovered = _prev_overall == UNHEALTHY and overall != UNHEALTHY
         _prev_overall = overall
     if went_unhealthy:
-        flight.auto_dump("health_unhealthy")
-    return {
+        events.publish(
+            "health_edge", "health_unhealthy",
+            status=overall,
+            indexes={n: r["status"] for n, r in indexes.items()},
+        )
+    elif recovered:
+        events.publish(
+            "health_edge", "health_recovered", recovered=True,
+            status=overall,
+        )
+    report = {
         "status": overall,
         "memory": mem,
         "indexes": indexes,
         "flight": flight.last_dump(),
     }
+    if budget is not None:
+        report["slo"] = budget
+    return report

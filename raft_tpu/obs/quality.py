@@ -44,7 +44,7 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 from raft_tpu.core.logger import child as _child_logger
-from raft_tpu.obs import flight
+from raft_tpu.obs import events
 from raft_tpu.obs.registry import MetricsRegistry, default_registry
 from raft_tpu.stats.metrics import rank_displacement, recall_at_k
 
@@ -248,9 +248,10 @@ class QualityAuditor:
             st["displacement"] = displacement
             ewma = float(st["ewma"])
             fire = ewma < self.threshold and not st["alarmed"]
+            rearm = bool(st["alarmed"]) and ewma >= self.threshold
             if fire:
                 st["alarmed"] = True
-            elif ewma >= self.threshold:
+            elif rearm:
                 st["alarmed"] = False
         reg.gauge(
             "raft_tpu_recall_ewma",
@@ -263,16 +264,28 @@ class QualityAuditor:
                 sample.name, sample.version, ewma, self.threshold,
                 recall, int(st["n"]),
             )
-            # the alarm edge is an incident: capture the in-flight batches
-            # while they are still in the recorder ring (debounced, so a
-            # subsequent UNHEALTHY healthz() does not double-dump)
-            flight.auto_dump("quality_alarm")
+            # the alarm edge is an incident: the bus's flight subscriber
+            # captures the in-flight batches while they are still in the
+            # recorder ring (debounced, so a subsequent UNHEALTHY
+            # healthz() does not double-dump) and the incident manager
+            # opens the timeline
+            events.publish(
+                "quality_alarm",
+                index=sample.name, version=sample.version,
+                ewma=ewma, threshold=self.threshold, last=recall,
+            )
             cb = self.on_degraded
             if cb is not None:
                 try:
                     cb(sample.name, sample.version, ewma)
                 except Exception:
                     _log.exception("on_degraded callback raised")
+        elif rearm:
+            # recovery edge: tells the incident manager the story is over
+            events.publish(
+                "quality_alarm", "quality_recovered", recovered=True,
+                index=sample.name, version=sample.version, ewma=ewma,
+            )
 
     def _worker(self) -> None:
         while True:

@@ -247,6 +247,18 @@ class Histogram(_Metric):
                 }
         return out
 
+    def bucket_totals(self) -> Dict[LabelValue, Tuple[List[int], int]]:
+        """``{labels: (bucket_counts, count)}`` — the cheap read for
+        periodic pollers (the SLO evaluator).  Unlike :meth:`collect`
+        this copies no reservoirs or exemplars, so the lock — shared
+        with hot-path ``observe()`` — is held for O(buckets) per series
+        instead of O(reservoir)."""
+        with self._lock:
+            return {
+                k: (list(s.bucket_counts), int(s.count))
+                for k, s in self._series.items()
+            }
+
     def bucket_edge(self, i: int) -> float:
         """Upper edge of bucket ``i`` (``inf`` for the overflow slot)."""
         return self.buckets[i] if i < len(self.buckets) else float("inf")

@@ -64,6 +64,7 @@ import numpy as np
 
 from raft_tpu.core import env as _env
 from raft_tpu.core.trace import trace_range
+from raft_tpu.obs import events as obs_events
 from raft_tpu.obs import flight, slowlog, spans
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
 
@@ -544,6 +545,7 @@ class MicroBatcher:
             off += m
         t_pad = time.perf_counter() - t_start
         sp = None
+        err_stage = "dispatch"
         try:
             c0 = compile_count(thread=True)
             with trace_range("serve.batch") as sp:
@@ -551,6 +553,7 @@ class MicroBatcher:
                 # dispatch: host-side tracing + enqueue of the executable
                 dist, ids = self._search_fn(jax.numpy.asarray(padded))
                 t1 = time.perf_counter()
+                err_stage = "device"
                 # device: waiting for the result to materialize — the serial
                 # path's one intended sync (the pipelined path moves it to
                 # the completion thread)
@@ -573,7 +576,12 @@ class MicroBatcher:
                 waits_s={"queue": max(queue_waits, default=0.0)},
                 error=repr(exc),
             )
-            flight.auto_dump("batch_exception")
+            self.metrics.record_error(err_stage, len(batch))
+            obs_events.publish(
+                "batch_error", "batch_exception",
+                index=self.metrics.name, bucket=bucket, cause=err_stage,
+                requests=len(batch), error=repr(exc),
+            )
             for req in batch:
                 req.future.set_exception(exc)
             return
@@ -618,7 +626,10 @@ class MicroBatcher:
         if compiles and self._warm:
             # a recompile on the warmed hot path is a shape leak: capture
             # the surrounding traffic while it is still in the ring
-            flight.auto_dump("hot_recompile")
+            obs_events.publish(
+                "hot_recompile",
+                index=self.metrics.name, bucket=bucket, compiles=compiles,
+            )
         if sp is not None:
             slowlog.maybe_record(
                 sp,
@@ -747,7 +758,12 @@ class MicroBatcher:
                     },
                     error=repr(exc),
                 )
-                flight.auto_dump("batch_exception")
+                self.metrics.record_error("dispatch", len(batch))
+                obs_events.publish(
+                    "batch_error", "batch_exception",
+                    index=self.metrics.name, bucket=bucket,
+                    cause="dispatch", requests=len(batch), error=repr(exc),
+                )
                 for req in batch:
                     req.future.set_exception(exc)
                 return None
@@ -803,7 +819,12 @@ class MicroBatcher:
                 },
                 error=repr(exc),
             )
-            flight.auto_dump("batch_exception")
+            self.metrics.record_error("device", len(batch))
+            obs_events.publish(
+                "batch_error", "batch_exception",
+                index=self.metrics.name, bucket=rec.bucket, cause="device",
+                requests=len(batch), error=repr(exc),
+            )
             for req in batch:
                 req.future.set_exception(exc)
             return
@@ -870,7 +891,11 @@ class MicroBatcher:
         if rec.compiles and self._warm:
             # a recompile on the warmed hot path is a shape leak: capture
             # the surrounding traffic while it is still in the ring
-            flight.auto_dump("hot_recompile")
+            obs_events.publish(
+                "hot_recompile",
+                index=self.metrics.name, bucket=rec.bucket,
+                compiles=rec.compiles,
+            )
         if rec.sp is not None:
             slowlog.maybe_record(
                 rec.sp,

@@ -59,6 +59,7 @@ import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.core import env as _env
+from raft_tpu.obs import events as obs_events
 from raft_tpu.core.logger import child as _child_logger
 from raft_tpu.core.trace import trace_range, traced
 from raft_tpu.distance import DISTANCE_TYPES
@@ -305,6 +306,10 @@ class Compactor:
         if deletes == 0 and side == 0:
             return {"name": name, "status": "noop", "reason": "clean"}
         t0 = time.perf_counter()
+        obs_events.publish(
+            "compaction_trigger",
+            index=name, version=version, deletes=deletes, side=side,
+        )
         self._progress(name, 0.0)
 
         with mi._lock:
@@ -356,6 +361,10 @@ class Compactor:
 
         # ---- promote ----------------------------------------------------
         new_version = self.promote(name, mi, cap, shadow_mi)
+        obs_events.publish(
+            "compaction_promote",
+            index=name, old_version=version, version=new_version,
+        )
         self._progress(name, 1.0)
         with self._lock:
             self._compactions += 1
@@ -747,6 +756,13 @@ class Compactor:
             "raft_tpu_compaction_aborts_total",
             help="compaction passes aborted (gate/budget/error)",
         ).inc(index=name, reason=reason)
+        # the abort→DEGRADED wiring rides the bus too: healthz folds
+        # stats()["last_abort"] into its verdict, and this event opens /
+        # annotates the incident timeline alongside it
+        obs_events.publish(
+            "compaction_abort", f"compaction_abort_{reason}",
+            index=name, cause=reason, detail=detail,
+        )
         _log.warning("compaction of %r aborted (%s): %s", name, reason, detail)
         return entry
 

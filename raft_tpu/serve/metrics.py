@@ -119,6 +119,7 @@ class ServingMetrics:
         self.name = name
         self.requests = 0
         self.batches = 0
+        self.errors: Dict[str, int] = {}   # failed requests by cause
         self.recompiles = 0        # compiles attributed to serve dispatches
         self.warmup_compiles = 0   # compiles spent in explicit warmup
         self._fill_real = 0        # sum of real rows over all batches
@@ -214,6 +215,21 @@ class ServingMetrics:
                 for v in vals:
                     st_h.observe(v, stage=s, **label)
 
+    def record_error(self, cause: str, count: int = 1) -> None:
+        """``count`` requests failed at stage ``cause`` (``"dispatch"``:
+        the search callable raised; ``"device"``: the device-side
+        completion raised).  Failed requests never reach
+        :meth:`record_batch`, so without this the availability SLO would
+        read a dead index as 100% available.  Mirrored per cause as
+        ``raft_tpu_serve_errors_total{index=,cause=}``."""
+        with self._lock:
+            self.errors[cause] = self.errors.get(cause, 0) + int(count)
+        if self.name is not None:
+            obs.default_registry().counter(
+                "raft_tpu_serve_errors_total",
+                help="failed served requests by failure cause",
+            ).inc(count, index=self.name, cause=cause)
+
     def record_queue_depth(self, depth: int) -> None:
         """Rows still queued at dispatch time — the health/backpressure
         signal.  Mirrored as a gauge for named instances."""
@@ -269,6 +285,7 @@ class ServingMetrics:
             out: Dict[str, object] = {
                 "requests": self.requests,
                 "batches": self.batches,
+                "errors": dict(self.errors),
                 "recompiles": self.recompiles,
                 "warmup_compiles": self.warmup_compiles,
                 "queue_depth": self._queue_depth,

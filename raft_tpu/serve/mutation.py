@@ -32,6 +32,7 @@ batch).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -168,6 +169,10 @@ class MutableIndex:
             else (int(main_ids.max()) + 1 if main_ids.size else 0)
         )
         self._generation = 0
+        # monotonic stamp of when the mutation backlog last became
+        # non-empty; None while empty.  Feeds the freshness SLI: age of
+        # the oldest un-compacted mutation, not a per-row watermark.
+        self._backlog_since: Optional[float] = None
         # set by a compaction promote: mutations arriving after the
         # hot-swap forward to the replacement so they are never lost
         self._retired_to: Optional["MutableIndex"] = None
@@ -321,6 +326,12 @@ class MutableIndex:
 
     def _bump_locked(self) -> None:
         self._generation += 1
+        deletes = self._n_deleted - self._n_structural
+        side = int(self._side_live.sum()) if self._side_count else 0
+        if deletes <= 0 and side <= 0:
+            self._backlog_since = None
+        elif self._backlog_since is None:
+            self._backlog_since = time.monotonic()
         self._refresh_snapshot_locked()
 
     def _refresh_snapshot_locked(self) -> None:
@@ -412,6 +423,22 @@ class MutableIndex:
                 self._n_deleted - self._n_structural,
                 int(self._side_live.sum()),
             )
+
+    def backlog_age_s(self) -> float:
+        """Seconds since the mutation backlog last became non-empty.
+
+        0.0 while the backlog is empty — this is the freshness SLI: how
+        long the oldest un-compacted mutation has been waiting for a
+        rebuild, the thing the freshness SLO bounds."""
+        with self._lock:
+            deletes = self._n_deleted - self._n_structural
+            side = int(self._side_live.sum()) if self._side_count else 0
+            if deletes <= 0 and side <= 0:
+                self._backlog_since = None
+                return 0.0
+            if self._backlog_since is None:
+                self._backlog_since = time.monotonic()
+            return time.monotonic() - self._backlog_since
 
     def live_vectors(self) -> Tuple[np.ndarray, np.ndarray]:
         """Materialize (vectors, ids) of every live row — rebuild input.

@@ -22,6 +22,7 @@ import weakref
 from typing import Dict, List, Optional, Tuple
 
 from raft_tpu.core import serialize as ser
+from raft_tpu.obs import events as obs_events
 from raft_tpu.serve.mutation import MutableIndex
 
 _MANIFEST_VERSION = 1
@@ -61,14 +62,23 @@ class IndexRegistry:
                 "MutableIndex(index) or ShardedIndex.from_index(index)"
             )
         with self._lock:
+            prev = self._entries.get(name)
             if version is None:
-                prev = self._entries.get(name)
                 version = prev[1] + 1 if prev is not None else 1
             # tuple replacement is a single reference store — atomic for
             # readers holding no lock
             self._entries[name] = (index, version)
             self._history[(name, version)] = index
-            return version
+        # context event, published outside the lock: annotates any open
+        # incident so "quality degraded right after version 7 went live"
+        # reads off one timeline.  First-time registration is bootstrap,
+        # not a swap — no event.
+        if prev is not None:
+            obs_events.publish(
+                "registry_swap",
+                index=name, version=version, prev_version=prev[1],
+            )
+        return version
 
     def swap(self, name: str, index: MutableIndex) -> int:
         """Hot-swap an existing name; raises KeyError if unknown."""
@@ -78,7 +88,11 @@ class IndexRegistry:
             version = self._entries[name][1] + 1
             self._entries[name] = (index, version)
             self._history[(name, version)] = index
-            return version
+        obs_events.publish(
+            "registry_swap",
+            index=name, version=version, prev_version=version - 1,
+        )
+        return version
 
     def unregister(self, name: str) -> None:
         with self._lock:

@@ -31,12 +31,14 @@ Typical lifecycle::
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Union
+from typing import Dict, Optional, Sequence, Union
 
 from raft_tpu import obs
 from raft_tpu.core.trace import traced
 from raft_tpu.obs import cost as obs_cost
 from raft_tpu.obs import health as obs_health
+from raft_tpu.obs import incidents as obs_incidents
+from raft_tpu.obs import slo as obs_slo
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
@@ -64,6 +66,9 @@ class SearchService:
         cost_accounting: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
         compaction: Union[None, bool, CompactionPolicy, Compactor] = None,
+        slo: Union[
+            None, bool, Sequence[obs_slo.SloSpec], obs_slo.SloEngine
+        ] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -98,6 +103,29 @@ class SearchService:
                 self,
                 start=start and not CompactionPolicy.disabled_by_env(),
             )
+        # slo=None/False: no engine.  True: default objectives added per
+        # served index (watch_index on add_index).  A sequence of SloSpec:
+        # engine with exactly those objectives.  A prebuilt SloEngine is
+        # adopted as-is (caller owns its start state).
+        self.slo_engine: Optional[obs_slo.SloEngine] = None
+        self._slo_auto = False  # add default specs on add_index?
+        if isinstance(slo, obs_slo.SloEngine):
+            self.slo_engine = slo
+        elif slo is True:
+            self.slo_engine = obs_slo.SloEngine(service=self)
+            self._slo_auto = True
+            if start:
+                self.slo_engine.start()
+        elif slo:
+            self.slo_engine = obs_slo.SloEngine(tuple(slo), service=self)
+            if start:
+                self.slo_engine.start()
+        # incident timelines carry a service snapshot at open/close —
+        # registry versions and queue depths, the facts an operator wants
+        # next to "what fired"
+        obs_incidents.default_manager().add_context_source(
+            "service", self._incident_context
+        )
 
     # -- index management ----------------------------------------------------
     def add_index(
@@ -134,6 +162,8 @@ class SearchService:
             self._batchers[name] = batcher
         if old is not None:
             old.stop()
+        if self.slo_engine is not None and self._slo_auto and old is None:
+            self.slo_engine.watch_index(name)
         if warmup:
             batcher.warmup()
         return version
@@ -208,6 +238,8 @@ class SearchService:
             self._ks.pop(name, None)
         batcher.stop()
         self.registry.unregister(name)
+        if self.slo_engine is not None and self._slo_auto:
+            self.slo_engine.unwatch_index(name)
 
     def names(self):
         return self.registry.names()
@@ -306,6 +338,30 @@ class SearchService:
         except Exception:  # mutation pressure gauges likewise
             pass
 
+    def _incident_context(self) -> Dict[str, object]:
+        """Snapshot attached to incident timelines at open/close.
+
+        Deliberately lock-light: registry versions and queue depths only —
+        no index or compactor internals, so a context capture triggered by
+        a publish from inside the serve stack cannot re-enter the lock the
+        publisher holds."""
+        indexes: Dict[str, object] = {}
+        for name in self.registry.names():
+            try:
+                _index, version = self.registry.get_versioned(name)
+            except KeyError:  # removed between names() and here
+                continue
+            entry: Dict[str, object] = {"version": version}
+            try:
+                entry["queue_depth"] = self._batcher(name).queue_depth()
+            except KeyError:
+                pass
+            indexes[name] = entry
+        ctx: Dict[str, object] = {"indexes": indexes}
+        if self.slo_engine is not None:
+            ctx["slo"] = self.slo_engine.health()
+        return ctx
+
     def healthz(self) -> Dict[str, object]:
         """Aggregated health verdict: OK / DEGRADED / UNHEALTHY.
 
@@ -324,6 +380,10 @@ class SearchService:
         (debounced), and the report's ``flight`` key carries the latest
         dump's JSON + Chrome-trace paths — the payload that announces the
         incident also says where the evidence landed.
+
+        With an SLO engine attached (``slo=`` knob) the report also folds
+        in the error-budget check: an exhausted budget is DEGRADED, and
+        the detail names the offending objectives under ``slo``.
         """
         self._refresh_capacity_gauges()
         auditor = self.auditor
@@ -361,7 +421,14 @@ class SearchService:
                     else None
                 ),
             )
-        return obs_health.build_report(probes, registry=obs.default_registry())
+        return obs_health.build_report(
+            probes,
+            registry=obs.default_registry(),
+            slo=(
+                self.slo_engine.health()
+                if self.slo_engine is not None else None
+            ),
+        )
 
     def readyz(self) -> Dict[str, object]:
         """Readiness: every served index warmed (bucket ladder compiled).
@@ -383,11 +450,14 @@ class SearchService:
         the slow-query log, and each index's ``serve.<name>`` section;
         ``health`` is the :meth:`healthz` report.
         """
-        return {
+        out = {
             "indexes": {n: self.stats(n) for n in self.names()},
             "health": self.healthz(),
             "registry": obs.snapshot(),
         }
+        if self.slo_engine is not None:
+            out["slo"] = self.slo_engine.snapshot()
+        return out
 
     def prometheus(self) -> str:
         """The process metrics registry in Prometheus text format.
@@ -419,6 +489,12 @@ class SearchService:
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
+        try:
+            obs_incidents.default_manager().remove_context_source("service")
+        except Exception:  # bus already reset (test teardown ordering)
+            pass
         # compactor first: a pass mid-flight may still submit warmup work
         # through the batchers it is about to go down with
         if self.compactor is not None:

@@ -67,13 +67,15 @@ def _reset_obs_globals(monkeypatch, tmp_path):
     Counters/gauges/histogram *counts* are deliberately left alone — the
     existing suites assert on monotonic totals.
     """
-    from raft_tpu.obs import flight, health, spans
+    from raft_tpu.obs import events, flight, health, spans
     from raft_tpu.obs.registry import default_registry
 
     monkeypatch.setenv("RAFT_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    events.reset()  # drops the default bus + incident manager + debounce
     flight.reset()
     health.reset_transitions()
     yield
+    events.reset()
     flight.reset()
     health.reset_transitions()
     spans.clear_recent()

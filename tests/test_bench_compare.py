@@ -420,3 +420,42 @@ def test_compact_churn_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_slo_engine_overhead_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the SLO-engine A/B: run ``bench.py slo`` (pooled
+    interleaved rounds, background evaluator on a 200 ms tick vs no
+    engine) and gate it with ``bench.py compare`` against the frozen
+    record.  The run must show the evaluator actually ticked, burned no
+    budget on an error-free workload, added no recompiles, and cost <2%
+    QPS on average (the acceptance bar; the assert allows single-core
+    CI scheduling noise on top — each evaluator wake preempts the only
+    serving core there, so one pooled run still swings a few percent)."""
+    candidate = str(tmp_path / "slo_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "slo"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "slo leg recompiled on the hot path"
+    on = line["slo_on"]
+    assert on["evals"] > 0
+    assert on["budget_remaining"] > 0.0
+    assert line["qps_ratio"] >= 0.90, (
+        f"SLO engine overhead out of tolerance: {line['overhead_pct']}%"
+    )
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_slo_r10.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

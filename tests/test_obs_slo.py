@@ -1,0 +1,463 @@
+"""Event bus + SLO engine + incident timelines (the obs v4 layer).
+
+Covers the typed pub/sub bus (ordering, overflow accounting, per-reason
+debounce, subscriber-error isolation, reentrancy cap), the flight
+subscriber's migration off the old global debounce window, the SLO
+engine's multi-window multi-burn-rate alerting driven with a synthetic
+monotonic clock (no real sleeps), the freshness SLI's backlog-age
+source, and the two acceptance scenarios: a corrupted index whose
+quality alarm + health edge + flight dump correlate into exactly ONE
+incident at pipeline depth 2, and a synthetic budget exhaustion that
+walks slo_burn → open incident → DEGRADED healthz → auto-close on
+recovery.
+
+Shapes here are deliberately distinct (d=20) from tests/test_serve.py
+(d=24), tests/test_obs.py (d=28), tests/test_obs_flight.py (d=16),
+tests/test_obs_quality.py (d=32) and tests/test_serve_pipeline.py
+(d=8): all suites share one process and one jit cache.
+"""
+
+import copy
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import obs, serve
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import events, flight, incidents, slo
+from raft_tpu.obs import health as obs_health
+from raft_tpu.obs.quality import QualityAuditor
+from raft_tpu.obs.registry import MetricsRegistry
+from raft_tpu.serve.registry import IndexRegistry
+
+D = 20  # this suite's own query dimensionality (see module docstring)
+
+
+# ---------------------------------------------------------------------------
+# event bus
+
+
+class TestEventBus:
+    def test_publish_rejects_unknown_kind(self):
+        bus = events.EventBus(ring=8)
+        with pytest.raises(ValueError, match="unknown event kind"):
+            bus.publish("made_up_kind")
+
+    def test_reason_defaults_to_kind_and_fields_round_trip(self):
+        bus = events.EventBus(ring=8)
+        e = bus.publish("hot_recompile", index="x", bucket=32)
+        assert e.reason == "hot_recompile"
+        assert e.to_dict()["bucket"] == 32
+
+    def test_ordering_overflow_and_drops_under_concurrent_publishers(self):
+        n_threads, per = 8, 50
+        ring = 64
+        bus = events.EventBus(ring=ring)
+        seen = []
+        lock = threading.Lock()
+
+        def sink(event):
+            with lock:
+                seen.append(event)
+
+        bus.subscribe(sink, name="sink")
+
+        def worker(tid):
+            for i in range(per):
+                bus.publish("batch_error", f"thread_{tid}", thread=tid, i=i)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        total = n_threads * per
+        assert len(seen) == total
+        seqs = [e.seq for e in seen]
+        assert set(seqs) == set(range(1, total + 1)), "seq gaps or dupes"
+        # per-publisher ordering: each thread's i-th publish got a lower
+        # seq than its (i+1)-th (the bus stamps under one lock window)
+        for tid in range(n_threads):
+            mine = sorted(
+                (e.fields["i"], e.seq)
+                for e in seen if e.fields["thread"] == tid
+            )
+            assert [s for _, s in mine] == sorted(s for _, s in mine)
+        # ring keeps exactly the newest `ring` events, oldest first
+        recent = bus.recent()
+        assert [e.seq for e in recent] == list(
+            range(total - ring + 1, total + 1)
+        )
+        snap = bus.snapshot()
+        assert snap["dropped"] == total - ring
+        assert snap["published"]["batch_error"] == total
+        assert "sink" in snap["subscribers"]
+
+    def test_subscriber_exception_is_swallowed_and_counted(self):
+        bus = events.EventBus(ring=8)
+        delivered = []
+        bus.subscribe(
+            lambda e: (_ for _ in ()).throw(RuntimeError("boom")),
+            name="boom",
+        )
+        bus.subscribe(delivered.append, name="ok")
+        errors = obs.default_registry().counter(
+            "raft_tpu_events_subscriber_errors_total"
+        )
+        before = errors.value(subscriber="boom")
+        bus.publish("batch_error", "oops")
+        assert len(delivered) == 1, "later subscriber starved by earlier"
+        assert errors.value(subscriber="boom") == before + 1
+
+    def test_per_reason_debounce_suppresses_same_reason_only(self):
+        bus = events.EventBus(ring=8)
+        delivered = []
+        bus.subscribe(
+            lambda e: delivered.append(e.reason),
+            debounce_s=60.0, name="debounced",
+        )
+        bus.publish("quality_alarm", "alarm_a")
+        bus.publish("quality_alarm", "alarm_a")   # same reason: suppressed
+        bus.publish("hot_recompile", "alarm_b")   # distinct reason: delivered
+        assert delivered == ["alarm_a", "alarm_b"]
+        debounced = obs.default_registry().counter(
+            "raft_tpu_events_debounced_total"
+        )
+        assert debounced.value(
+            subscriber="debounced", reason="alarm_a"
+        ) >= 1
+
+    def test_reentrant_publish_chain_is_capped(self):
+        bus = events.EventBus(ring=64)
+        bus.subscribe(
+            lambda e: bus.publish("hot_recompile", "chain"),
+            name="republisher",
+        )
+        bus.publish("hot_recompile", "chain")
+        # depth cap 4: the seed delivery plus 3 nested ones dispatch, the
+        # publish at max depth is recorded but not dispatched
+        assert bus.snapshot()["published"]["hot_recompile"] == 5
+
+    def test_kind_filter(self):
+        bus = events.EventBus(ring=8)
+        got = []
+        bus.subscribe(
+            lambda e: got.append(e.kind),
+            kinds=frozenset({"slo_burn"}), name="filtered",
+        )
+        bus.publish("hot_recompile")
+        bus.publish("slo_burn", "slo_burn_x")
+        assert got == ["slo_burn"]
+
+
+# ---------------------------------------------------------------------------
+# flight subscriber: per-reason debounce + cross-reason correlation guard
+
+
+class TestFlightTriggerMigration:
+    def test_distinct_reasons_no_longer_suppress_each_other(
+        self, monkeypatch
+    ):
+        # the pre-bus bug: one global window meant a quality_alarm dump
+        # suppressed a later *unrelated* hot_recompile dump.  With the
+        # correlation guard off, only same-reason debounce applies.
+        monkeypatch.setenv("RAFT_TPU_INCIDENT_WINDOW_S", "0")
+        events.reset()  # rebuild the bus + subscribers with fresh knobs
+
+        events.publish("quality_alarm", index="x", ewma=0.1)
+        d1 = flight.last_dump()
+        assert d1 is not None and d1["reason"] == "quality_alarm"
+
+        events.publish("hot_recompile", index="x", bucket=8)
+        d2 = flight.last_dump()
+        assert d2["reason"] == "hot_recompile"
+        assert d2["path"] != d1["path"], (
+            "distinct reason suppressed by another reason's window"
+        )
+
+        # same reason inside its window IS still debounced
+        events.publish("quality_alarm", index="x", ewma=0.1)
+        assert flight.last_dump()["path"] == d2["path"]
+
+    def test_correlated_triggers_share_one_artifact(self):
+        # default 5 s correlation window: several symptoms of one
+        # incident produce one dump (the existing acceptance behavior)
+        events.reset()
+        events.publish("quality_alarm", index="x", ewma=0.1)
+        d1 = flight.last_dump()
+        suppressed = obs.default_registry().counter(
+            "raft_tpu_flight_dumps_suppressed_total"
+        )
+        before = suppressed.value(reason="health_unhealthy")
+        events.publish("health_edge", "health_unhealthy", status="UNHEALTHY")
+        assert flight.last_dump()["path"] == d1["path"]
+        assert suppressed.value(reason="health_unhealthy") == before + 1
+
+    def test_recovery_events_never_dump(self):
+        events.reset()
+        events.publish(
+            "health_edge", "health_recovered", recovered=True, status="OK"
+        )
+        assert flight.last_dump() is None
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+
+
+class TestSloEngine:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            slo.SloSpec("bad", "i", "nonsense_kind", 0.99)
+        with pytest.raises(ValueError):
+            slo.SloSpec("bad", "i", "availability", 1.5)
+
+    def test_burn_rate_fires_and_rearms_without_sleeping(self):
+        reg = MetricsRegistry()
+        spec = slo.SloSpec("svc-availability", "svc", "availability", 0.999)
+        # scale 1/3600 shrinks the fast policy to a 1 s long window and
+        # ~83 ms short window; the clock below is synthetic either way
+        engine = slo.SloEngine(
+            [spec], registry=reg, scale=1.0 / 3600.0,
+            eval_s=10.0, budget_window_s=2_592_000.0,
+        )
+        burns = []
+        events.subscribe(
+            lambda e: burns.append(e),
+            kinds=frozenset({"slo_burn"}), name="capture",
+        )
+        t0 = 1000.0
+        engine.evaluate_once(now=t0)
+        assert engine.health() == {"exhausted": [], "alerting": []}
+
+        # 50% error rate: burn 500x budget, far over both thresholds
+        reg.counter("raft_tpu_serve_requests_total").inc(100, index="svc")
+        reg.counter(
+            "raft_tpu_serve_errors_total"
+        ).inc(100, index="svc", cause="device")
+        engine.evaluate_once(now=t0 + 0.02)
+        fired = [e for e in burns if not e.recovered]
+        assert fired, "burn-rate alert did not fire"
+        assert any(e.fields["policy"] == "fast" for e in fired)
+        assert engine.health()["alerting"] == ["svc-availability"]
+        assert reg.gauge("raft_tpu_slo_burn_rate").value(
+            slo="svc-availability", window="fast"
+        ) > 14.4
+        assert reg.gauge("raft_tpu_slo_alert").value(
+            slo="svc-availability", policy="fast"
+        ) == 1.0
+        assert engine.budget_remaining("svc-availability") < 1.0
+
+        # clean traffic + enough synthetic time that every short window
+        # holds only good samples: both policies re-arm
+        reg.counter("raft_tpu_serve_requests_total").inc(1000, index="svc")
+        engine.evaluate_once(now=t0 + 2.0)
+        engine.evaluate_once(now=t0 + 10.0)
+        assert engine.health()["alerting"] == []
+        recovered = [e for e in burns if e.recovered]
+        assert any(e.fields["policy"] == "fast" for e in recovered)
+        assert reg.gauge("raft_tpu_slo_alert").value(
+            slo="svc-availability", policy="fast"
+        ) == 0.0
+        engine.stop()
+
+    def test_counter_baseline_primed_at_add_spec(self):
+        reg = MetricsRegistry()
+        # history from before the spec existed must not burn budget
+        reg.counter("raft_tpu_serve_requests_total").inc(10, index="old")
+        reg.counter(
+            "raft_tpu_serve_errors_total"
+        ).inc(10, index="old", cause="device")
+        engine = slo.SloEngine(
+            [slo.SloSpec("old-availability", "old", "availability", 0.999)],
+            registry=reg, scale=1.0, eval_s=1.0, budget_window_s=100.0,
+        )
+        engine.evaluate_once(now=50.0)
+        engine.evaluate_once(now=60.0)
+        assert engine.budget_remaining("old-availability") == 1.0
+        engine.stop()
+
+    def test_freshness_sli_reads_backlog_age(self, rng):
+        x = rng.random((64, D), dtype=np.float32)
+        built = ivf_flat.build(ivf_flat.IndexParams(n_lists=4), x)
+        mi = serve.MutableIndex(built)
+        assert mi.backlog_age_s() == 0.0
+
+        registry = IndexRegistry()
+        registry.register("f", mi)
+        stub = types.SimpleNamespace(registry=registry, auditor=None)
+        reg = MetricsRegistry()
+        engine = slo.SloEngine(
+            [slo.SloSpec("f-freshness", "f", "freshness", 0.99,
+                         target=1e-9)],
+            service=stub, registry=reg, scale=1.0, eval_s=1.0,
+            budget_window_s=100.0,
+        )
+        engine.evaluate_once(now=1.0)
+        assert engine.snapshot()["specs"]["f-freshness"]["sli"] == 1.0
+
+        mi.delete(np.array([0]))          # backlog opens, age starts
+        assert mi.backlog_age_s() > 0.0
+        engine.evaluate_once(now=2.0)
+        assert engine.snapshot()["specs"]["f-freshness"]["sli"] == 0.0
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: correlation + budget exhaustion end to end
+
+
+def _clustered(rng, n, n_q):
+    centers = (rng.standard_normal((24, D)) * 6.0).astype(np.float32)
+    x = (
+        centers[rng.integers(0, 24, n)]
+        + rng.standard_normal((n, D)).astype(np.float32) * 0.25
+    )
+    q = (
+        centers[rng.integers(0, 24, n_q)]
+        + rng.standard_normal((n_q, D)).astype(np.float32) * 0.25
+    )
+    return x.astype(np.float32), q.astype(np.float32)
+
+
+def _corrupt(index, rng):
+    bad = copy.copy(index)
+    perm = rng.permutation(np.asarray(index.centers).shape[0])
+    bad.centers = jnp.asarray(np.asarray(index.centers)[perm])
+    return bad
+
+
+def test_corrupted_index_correlates_into_exactly_one_incident():
+    """quality alarm + health edge + flight dump → ONE incident, at
+    pipeline depth 2 (the PR's headline acceptance scenario)."""
+    rng = np.random.default_rng(31)
+    x, q = _clustered(rng, 600, 16)
+    good = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x)
+    bad = _corrupt(good, rng)
+    sp = ivf_flat.SearchParams(n_probes=1)
+
+    auditor = QualityAuditor(
+        k=10, sampling=1.0, threshold=1.0, ewma_alpha=0.5,
+        registry=MetricsRegistry(),
+    )
+    svc = serve.SearchService(
+        k=10, max_batch=8, max_delay_ms=1.0, auditor=auditor,
+        pipeline_depth=2,
+    )
+    try:
+        svc.add_index(
+            "slo_corr", serve.MutableIndex(bad, search_params=sp),
+            warmup=True,
+        )
+        for i in range(48):
+            svc.search("slo_corr", q[i % len(q)])
+        assert auditor.flush(timeout=30.0)
+        ewma = auditor.recall_ewma("slo_corr")
+        assert ewma is not None and ewma < 0.5
+
+        report = svc.healthz()
+        assert report["status"] == obs_health.UNHEALTHY
+        assert report["flight"] is not None
+
+        mgr = incidents.default_manager()
+        open_ = mgr.open_incidents()
+        assert len(open_) == 1, [i.summary() for i in open_]
+        inc = open_[0]
+        kinds = [e.get("kind") for e in inc.timeline]
+        assert "quality_alarm" in kinds
+        assert "health_edge" in kinds
+        assert kinds.count("flight_dump") == 1, (
+            "correlated symptoms produced more than one artifact"
+        )
+        assert inc.flight is not None
+        assert inc.flight["path"] == report["flight"]["path"]
+        # the service context source annotated the open bracket
+        assert "service" in (inc.context_open or {})
+        assert inc.context_open["service"]["indexes"]["slo_corr"][
+            "version"
+        ] == 1
+        assert mgr.snapshot()["opened_total"] == 1
+    finally:
+        svc.stop()
+        auditor.stop()
+
+
+def test_budget_exhaustion_walks_burn_incident_degraded_autoclose(rng):
+    """slo_burn event → open incident → healthz DEGRADED → incident
+    auto-closes once the alert re-arms and the timeline goes quiet."""
+    x = rng.random((96, D), dtype=np.float32)
+    built = ivf_flat.build(ivf_flat.IndexParams(n_lists=4), x)
+    spec = slo.SloSpec(
+        "slo_acc-availability", "slo_acc", "availability", 0.999
+    )
+    engine = slo.SloEngine(
+        [spec], scale=1.0, eval_s=1.0, budget_window_s=10.0,
+    )
+    svc = serve.SearchService(
+        k=3, max_batch=4, max_delay_ms=0.5, pipeline_depth=1, slo=engine,
+    )
+    try:
+        svc.add_index("slo_acc", serve.MutableIndex(built), warmup=True)
+
+        # synthetic failure: every request errors (dispatch-stage cause)
+        svc._batcher("slo_acc").metrics.record_error("device", 50)
+        t0 = time.monotonic()
+        engine.evaluate_once(now=t0)
+        engine.evaluate_once(now=t0 + 9.0)  # 90% of the budget window seen
+
+        assert engine.health()["exhausted"] == ["slo_acc-availability"]
+        assert engine.budget_remaining("slo_acc-availability") <= 0.0
+        burn_events = events.recent("slo_burn")
+        assert any(not e.recovered for e in burn_events)
+
+        mgr = incidents.default_manager()
+        open_ = mgr.open_incidents()
+        assert len(open_) == 1
+        assert open_[0].reason == "slo_burn_slo_acc-availability"
+
+        report = svc.healthz()
+        assert report["status"] == obs_health.DEGRADED
+        assert "budget exhausted" in report["slo"]["detail"]
+        assert "slo_acc-availability" in report["slo"]["detail"]
+
+        # recovery: clean traffic, then enough synthetic time that both
+        # short windows empty out — the alert re-arms (recovered event)
+        obs.default_registry().counter(
+            "raft_tpu_serve_requests_total"
+        ).inc(10_000, index="slo_acc")
+        engine.evaluate_once(now=t0 + 9.2)
+        engine.evaluate_once(now=t0 + 30_000.0)
+        assert engine.health()["alerting"] == []
+        assert any(e.recovered for e in events.recent("slo_burn"))
+
+        closed = mgr.poll(now=time.monotonic() + 31.0)
+        assert len(closed) == 1
+        assert closed[0].resolution == "recovered"
+        assert mgr.open_incidents() == []
+    finally:
+        svc.stop()
+
+
+def test_incidents_reset_alone_reattaches_to_live_bus():
+    """incidents.reset() without events.reset(): default_manager() must
+    re-attach a fresh manager to the surviving bus, and the old manager
+    must stop receiving events (no zombie subscription)."""
+    events.default_bus()
+    first = incidents.default_manager()
+    incidents.reset()
+
+    mgr = incidents.default_manager()
+    assert mgr is not first
+    events.publish("batch_error", reason="reattach_probe")
+    open_ = mgr.open_incidents()
+    assert len(open_) == 1 and open_[0].reason == "reattach_probe"
+    assert first.open_incidents() == []

@@ -49,9 +49,10 @@ def test_entry_point_discovery_is_not_vacuous(project):
 
 
 def test_serve_surface_discovery_is_not_vacuous(result):
-    # all nine online entry points (service/mutation/compactor) checked,
-    # against exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 9, result.stats
+    # all eleven online entry points (service/mutation/compactor plus
+    # the SLO evaluator and incident ingest) checked, against exactly
+    # one MicroBatcher
+    assert result.stats["traced_serve_entries_checked"] == 11, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
     assert result.stats["traced_labels"] >= 20, result.stats
 
