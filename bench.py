@@ -167,6 +167,9 @@ def main() -> None:
     if "serve" in sys.argv[1:]:
         run_serve_leg()
         return
+    if "ragged" in sys.argv[1:]:
+        run_ragged_leg()
+        return
     if "shard" in sys.argv[1:]:
         run_shard_leg()
         return
@@ -596,6 +599,236 @@ def run_serve_leg() -> None:
             "warmup_compiles": head["warmup_compiles"],
             "requests": n_requests,
             "n": n,
+            # explicit routing attribution: ask the shared pallas gate for
+            # the index's (metric, storage dtype) instead of letting the
+            # record default to the bare env opt-in
+            "kernel_path": _serve_kernel_path(),
+        }
+    )
+
+
+def _serve_kernel_path() -> dict:
+    """Pallas attribution for the ivf_flat-backed serving legs."""
+    import jax.numpy as jnp
+
+    from raft_tpu.bench.export import kernel_path
+
+    return kernel_path("sqeuclidean", jnp.float32)
+
+
+def run_ragged_leg() -> None:
+    """``python bench.py ragged`` — ragged vs pow2-ladder A/B (CPU).
+
+    Workload: single-query requests with heterogeneous per-request
+    ``(k, filter)`` drawn from a fixed mix (three ks × unfiltered/two
+    registered bitset filters), served closed-loop by many concurrent
+    clients against the same ivf_flat MutableIndex, under the same paced
+    serial-device model as ``bench.py serve`` (every host stage real,
+    result readiness paced to ``RAFT_TPU_BENCH_DEVICE_MS`` per batch).
+
+    Baseline arm is what classic mode forces for this traffic: one warmed
+    MicroBatcher **per (k, filter) variant** — requests fragment across
+    per-variant queues, each cutting small padded batches against the one
+    shared device.  Ragged arm is a single batcher in ragged mode: every
+    request packs into the same bucket dispatch with its ``(k, fid)``
+    riding as descriptor data, continuous admission packing the forming
+    batch while the device window is full.
+
+    Emits one BENCH line whose headline value is the ragged arm's QPS,
+    with the ladder arm's figures, the QPS ratio, warmup variant counts
+    (one per bucket per batcher — the executable-lattice size), padding
+    waste, and recompiles (must be 0 on both arms).
+    """
+    import threading
+    import types
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import slowlog
+    from raft_tpu.serve import IndexRegistry, MutableIndex
+    from raft_tpu.serve.batcher import MicroBatcher
+    from raft_tpu.serve.metrics import ServingMetrics
+    from raft_tpu.serve.ragged import (
+        FilterRegistry,
+        RaggedSearcher,
+        RaggedSpec,
+    )
+
+    n, d, k_max = 8192, 64, 32
+    n_requests, n_clients = 4096, 64
+    device_ms = float(os.environ.get("RAFT_TPU_BENCH_DEVICE_MS", "10"))
+    slowlog.configure(None)
+
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_requests, d), dtype=np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), dataset)
+    params = ivf_flat.SearchParams(n_probes=8)
+    mi = MutableIndex(index, search_params=params)
+
+    even = np.zeros(n, bool)
+    even[::2] = True
+    band = np.zeros(n, bool)
+    band[n // 4 : 3 * n // 4] = True
+    masks = {0: None, 1: even, 2: band}
+
+    ks = (2, 10, k_max)
+    combos = [(k, f) for k in ks for f in (0, 1, 2)]
+    plan = [combos[i] for i in rng.integers(0, len(combos), n_requests)]
+
+    class _Paced:
+        """Same modeled serial device as ``run_serve_leg`` (see there)."""
+
+        __slots__ = ("arr", "deadline")
+
+        def __init__(self, arr, deadline: float):
+            self.arr = arr
+            self.deadline = deadline
+
+        def block_until_ready(self):
+            jax.block_until_ready(self.arr)
+            rest = self.deadline - time.perf_counter()
+            if rest > 0:
+                time.sleep(rest)
+            return self
+
+        def __array__(self, dtype=None):
+            a = np.asarray(self.arr)
+            return a if dtype is None else a.astype(dtype)
+
+    def make_pacer():
+        """One serial modeled device per arm, shared by every batcher."""
+        lock = threading.Lock()
+        state = {"free": 0.0}
+
+        def pace(dist, ids):
+            with lock:
+                start = max(time.perf_counter(), state["free"])
+                state["free"] = deadline = start + device_ms * 1e-3
+            return _Paced(dist, deadline), _Paced(ids, deadline)
+
+        return pace
+
+    def drive(submit) -> float:
+        """Closed-loop clients: each submits one request, waits, repeats."""
+        def client(cid: int):
+            for i in range(cid, n_requests, n_clients):
+                submit(i).result(timeout=600)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def arm_stats(metrics, wall, warmup_variants):
+        st = metrics.snapshot()
+        return {
+            "qps": round(n_requests / wall, 1),
+            "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+            "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+            "batches": st["batches"],
+            "batch_fill": round(st["batch_fill"], 3)
+            if st["batch_fill"] else None,
+            "pad_waste_rows": st["pad_waste_rows"],
+            "recompiles": st["recompiles"],
+            "warmup_variants": warmup_variants,
+        }
+
+    def run_ladder_arm() -> dict:
+        pace = make_pacer()
+        metrics = ServingMetrics(name="bench-ladder")
+        batchers = {}
+        variants = 0
+        for k, f in combos:
+            bs = None if masks[f] is None else Bitset.from_mask(
+                jnp.asarray(masks[f])
+            )
+
+            def search_fn(batch, _k=k, _bs=bs):
+                return pace(*mi.search(batch, _k, sample_filter=_bs))
+
+            b = MicroBatcher(
+                search_fn, d, min_bucket=8, max_batch=32, max_delay_ms=0.5,
+                metrics=metrics, pipeline_depth=2, cost_accounting=False,
+            )
+            b.warmup()
+            variants += len(b.buckets())
+            batchers[(k, f)] = b
+        wall = drive(lambda i: batchers[plan[i]].submit(queries[i]))
+        out = arm_stats(metrics, wall, variants)
+        for b in batchers.values():
+            b.stop()
+        return out
+
+    def run_ragged_arm() -> dict:
+        pace = make_pacer()
+        metrics = ServingMetrics(name="bench-ragged")
+        spec = RaggedSpec(k_max=k_max)
+        reg = IndexRegistry()
+        reg.register("t", mi)
+        freg = FilterRegistry(n)
+        assert freg.register(even) == 1 and freg.register(band) == 2
+        searcher = RaggedSearcher(
+            types.SimpleNamespace(registry=reg), "t", spec, freg
+        )
+
+        def search_fn(batch, row_k, row_fid):
+            return pace(*searcher(batch, row_k, row_fid))
+
+        b = MicroBatcher(
+            search_fn, d, min_bucket=8, max_batch=32, max_delay_ms=0.5,
+            metrics=metrics, pipeline_depth=2, cost_accounting=False,
+            ragged=spec,
+        )
+        b.warmup()
+        variants = len(b.buckets())
+        wall = drive(
+            lambda i: b.submit(queries[i], k=plan[i][0], fid=plan[i][1])
+        )
+        out = arm_stats(metrics, wall, variants)
+        b.stop()
+        return out
+
+    ladder = run_ladder_arm()
+    ragged = run_ragged_arm()
+    ratio = (
+        round(ragged["qps"] / ladder["qps"], 3) if ladder["qps"] else None
+    )
+    reduction = (
+        round(ladder["warmup_variants"] / ragged["warmup_variants"], 2)
+        if ragged["warmup_variants"] else None
+    )
+    _emit(
+        {
+            "metric": f"serve_ragged_qps_ivf_flat_n{n // 1000}k_kmax{k_max}",
+            "value": ragged["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "device_ms": device_ms,
+            "arms": {"ladder": ladder, "ragged": ragged},
+            "qps_vs_ladder": ratio,
+            "warmup_variant_reduction": reduction,
+            "p50_ms": ragged["p50_ms"],
+            "p99_ms": ragged["p99_ms"],
+            "batch_fill": ragged["batch_fill"],
+            "pad_waste_rows": ragged["pad_waste_rows"],
+            "recompiles": ladder["recompiles"] + ragged["recompiles"],
+            "requests": n_requests,
+            "n": n,
+            "kernel_path": _serve_kernel_path(),
         }
     )
 

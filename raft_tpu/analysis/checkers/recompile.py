@@ -19,6 +19,14 @@ Inline-jitted closures are additionally checked for captured mutable
 Python containers (list/dict/set built in the enclosing scope): those
 are not hashable jit-cache keys and mutating them between calls skews
 tracing.
+
+The ragged serving path gets the same treatment without a jit
+decorator: the :data:`DESCRIPTOR_ENTRIES` functions run inside the
+batcher's already-compiled dispatch, where the per-request ``row_k``
+descriptor column is traced *data* — Python control flow on its value
+would re-specialize per batch mix, resurrecting the per-k executable
+lattice ragged mode exists to retire.  (``row_fid`` is exempt: its
+host-side table gather is the documented design.)
 """
 
 from __future__ import annotations
@@ -43,6 +51,18 @@ _STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
 
 _MUTABLE_CTORS = {"list", "dict", "set", "bytearray"}
 
+#: ragged descriptor-path functions (qualname suffix → descriptor params
+#: held to jit discipline even though the defs carry no @jax.jit — they
+#: execute inside the batcher's compiled dispatch).  Only the listed
+#: params are tainted: everything else on these signatures is either a
+#: plain array or deliberately host-side.
+DESCRIPTOR_ENTRIES = {
+    "serve.ragged.RaggedSearcher.__call__": ("row_k",),
+    "serve.mutation.MutableIndex.search": ("row_k",),
+    "ops.matrix.select_k": ("row_k",),
+    "ops.matrix.mask_row_k": ("row_k",),
+}
+
 
 def check(project: Project, result) -> None:
     n_entries = 0
@@ -57,6 +77,20 @@ def check(project: Project, result) -> None:
             ):
                 _check_closure(project, mod, node, enclosing, result)
     result.stats["recompile_jit_entries"] = n_entries
+    _check_descriptor_entries(project, result)
+
+
+def _check_descriptor_entries(project: Project, result) -> None:
+    n_desc = 0
+    for suffix, cols in sorted(DESCRIPTOR_ENTRIES.items()):
+        for fn in project.functions.values():
+            if not fn.qualname.endswith(suffix):
+                continue
+            n_desc += 1
+            static = {p for p in _params(fn.node) if p not in cols}
+            _check_entry(project, fn.module, fn.node, set(), static,
+                         result)
+    result.stats["recompile_descriptor_entries"] = n_desc
 
 
 # -- jit-entry discovery ----------------------------------------------------

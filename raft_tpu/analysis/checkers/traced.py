@@ -13,7 +13,12 @@ this checker) from runtime introspection to AST:
 * the pipelined dispatch path must keep its detached-span and
   request-id plumbing (``open_span``/``finish_span`` across threads,
   ``req_id`` through ``_Request.__slots__``, ``_record_flight`` with
-  member ``request_ids`` on both dispatch paths).
+  member ``request_ids`` on both dispatch paths),
+* the ragged descriptor plumbing must stay intact: per-request ``k`` /
+  ``fid`` ride ``_Request.__slots__`` into ``_invoke``'s descriptor
+  columns (``row_k`` / ``row_fid``), flight records carry member
+  ``fid``s, and the continuous-admission worker keeps its
+  ``sem_held`` slot-before-batch handoff.
 
 Discovery counts land in ``result.stats`` so the tier-1 test can
 assert the contract is not vacuously green.
@@ -49,6 +54,7 @@ SERVE_ENTRY_POINTS = {
     ("serve.service.SearchService", "flush"): "serve.flush",
     ("serve.mutation.MutableIndex", "upsert"): "serve.upsert",
     ("serve.mutation.MutableIndex", "delete"): "serve.delete",
+    ("serve.ragged.RaggedSearcher", "__call__"): "serve.ragged.dispatch",
     ("serve.compactor.Compactor", "compact"): "serve.compact",
     ("serve.compactor.Compactor", "promote"): "serve.compact.promote",
     ("serve.compactor.Compactor", "abort"): "serve.compact.abort",
@@ -276,18 +282,39 @@ def _check_batcher_plumbing(project: Project, result) -> None:
         require("_record_flight", "req_id",
                 "member request ids must cross into batch records")
 
-        # _Request.__slots__ must carry req_id so ids cross the queue
+        # ragged descriptor plumbing: per-request k/fid must ride the
+        # dispatch as data columns and land in flight records
+        require("_invoke", "row_k",
+                "ragged dispatches must pass the per-request k column")
+        require("_invoke", "row_fid",
+                "ragged dispatches must pass the per-request filter-id "
+                "column")
+        require("_record_flight", "fid",
+                "ragged batch records must carry member filter ids")
+        require("_worker", "sem_held",
+                "continuous admission claims the in-flight slot before "
+                "cutting the batch")
+
+        # _Request.__slots__ must carry req_id so ids cross the queue,
+        # and the ragged descriptor fields k / fid alongside it
         for req_cls in project.classes_matching(
             f"{mod.name.rsplit('.', 1)[-1]}._Request"
         ):
             if req_cls.module is not mod:
                 continue
             slots = _class_slots(req_cls.node)
-            if slots is not None and "req_id" not in slots:
+            if slots is None:
+                continue
+            for slot, why in (
+                ("req_id", "request ids cannot cross the queue"),
+                ("k", "per-request k cannot cross the queue"),
+                ("fid", "per-request filter ids cannot cross the queue"),
+            ):
+                if slot in slots:
+                    continue
                 f = project.finding(
                     "TRACED", mod, req_cls.node, req_cls.qualname,
-                    "_Request dropped its req_id slot; request ids "
-                    "cannot cross the queue",
+                    f"_Request dropped its {slot} slot; {why}",
                     suppressed_sink=result.suppressed,
                 )
                 if f is not None:

@@ -102,3 +102,127 @@ class Bitset:
         shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
         bits = (self.words[:, None] >> shifts[None, :]) & 1
         return bits.reshape(-1)[: self.n_bits].astype(bool)
+
+
+def _popcount_words(words: jax.Array) -> jax.Array:
+    """SWAR popcount per uint32 lane (any shape) → int32 counts."""
+    x = words
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+class RowFilter:
+    """Per-query-row pass filters packed as uint32 words: ``words[r]`` is
+    the pass bitset applied to query row ``r``.
+
+    The ragged serving path packs requests with heterogeneous predicate
+    bitsets into one batch; shipping the filter as a ``[rows, n_words]``
+    operand keeps the filter mix out of the compiled shape — any
+    combination of predicates reuses the one executable per capacity
+    bucket.  ``fid``/``table`` optionally carry the descriptor form
+    (per-row filter id into a ``[n_filters, n_words]`` table) for kernels
+    that prefer the indirect layout (kernels/ivf_scan's query-major leg
+    prefetches fid and gathers the table block per grid step).
+
+    ``pass_count`` is a host-int lower bound on the number of passing ids
+    in any row; heuristics that size work buffers from filter selectivity
+    (cagra's itopk widening) read it via :meth:`count` so the traffic mix
+    never feeds back into compiled shapes.
+    """
+
+    def __init__(
+        self,
+        words: jax.Array,
+        n_bits: int,
+        *,
+        fid: Optional[jax.Array] = None,
+        table: Optional[jax.Array] = None,
+        pass_count: Optional[int] = None,
+    ):
+        self.words = words
+        self.n_bits = n_bits
+        self.fid = fid
+        self.table = table
+        self.pass_count = pass_count
+
+    def tree_flatten(self):
+        return (self.words, self.fid, self.table), (self.n_bits, self.pass_count)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        words, fid, table = children
+        return cls(words, aux[0], fid=fid, table=table, pass_count=aux[1])
+
+    @classmethod
+    def from_mask_rows(cls, masks: jax.Array) -> "RowFilter":
+        """Pack a boolean [rows, n_bits] matrix into per-row word sets."""
+        rows, n_bits = masks.shape
+        nw = _n_words(n_bits)
+        padded = (
+            jnp.zeros((rows, nw * WORD_BITS), dtype=jnp.uint32)
+            .at[:, :n_bits]
+            .set(masks.astype(jnp.uint32))
+        )
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+        words = jnp.sum(
+            padded.reshape(rows, nw, WORD_BITS) << shifts[None, None, :],
+            axis=2,
+            dtype=jnp.uint32,
+        )
+        return cls(words, n_bits)
+
+    @classmethod
+    def from_table(
+        cls,
+        table: jax.Array,
+        fid,
+        n_bits: int,
+        *,
+        pass_count: Optional[int] = None,
+    ) -> "RowFilter":
+        """Build from a filter table [n_filters, n_words] + per-row ids.
+
+        The gather runs host-side (numpy) when given numpy inputs so filter
+        registration never becomes a traced shape: ``table`` grows with the
+        filter population while ``words`` stays [rows, n_words].
+        """
+        import numpy as np
+
+        if isinstance(table, np.ndarray):
+            words = jnp.asarray(table[np.asarray(fid)])
+        else:
+            words = jnp.asarray(table)[jnp.asarray(fid)]
+        return cls(
+            words,
+            n_bits,
+            fid=jnp.asarray(fid, jnp.int32),
+            table=jnp.asarray(table),
+            pass_count=pass_count,
+        )
+
+    def test_rows(self, ids: jax.Array) -> jax.Array:
+        """Per-row membership test: ids [rows, ...] → bool of ids.shape."""
+        ids = jnp.asarray(ids)
+        r = ids.shape[0]
+        flat = (jnp.clip(ids, 0, None) // WORD_BITS).reshape(r, -1)
+        word = jnp.take_along_axis(self.words, flat, axis=1).reshape(ids.shape)
+        bit = (word >> (jnp.clip(ids, 0, None) % WORD_BITS).astype(jnp.uint32)) & 1
+        return bit.astype(bool)
+
+    def count(self):
+        """Minimum per-row passing population (host int when pass_count is
+        pinned, else a traced scalar)."""
+        if self.pass_count is not None:
+            return self.pass_count
+        nw = self.words.shape[1]
+        tail_bits = self.n_bits - (nw - 1) * WORD_BITS
+        tail_mask = (
+            jnp.uint32(0xFFFFFFFF)
+            if tail_bits == WORD_BITS
+            else jnp.uint32((1 << tail_bits) - 1)
+        )
+        masked = self.words.at[:, -1].set(self.words[:, -1] & tail_mask)
+        return jnp.min(jnp.sum(_popcount_words(masked), axis=1))

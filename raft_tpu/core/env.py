@@ -62,6 +62,17 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_SHARD_MERGE_DTYPE", "str", "float32",
            "bfloat16 quantizes the cross-shard merge all-gather of "
            "ShardedIndex candidate distances"),
+    EnvVar("RAFT_TPU_RAGGED", "bool", "unset",
+           "1 serves SearchService indexes in ragged mode: per-request k "
+           "and filter id packed as descriptor data into one executable "
+           "per capacity bucket"),
+    EnvVar("RAFT_TPU_RAGGED_KMAX", "int", "32",
+           "ragged serving's static top-k capacity — every dispatch "
+           "computes this many columns; per-request k may not exceed it"),
+    EnvVar("RAFT_TPU_RAGGED_FILTERS", "bool", "1",
+           "0 drops the per-request filter-id column from ragged "
+           "dispatches (skips the RowFilter gather when no filters are "
+           "registered)"),
     # -- compaction ----------------------------------------------------------
     EnvVar("RAFT_TPU_COMPACT_DISABLED", "bool", "unset",
            "1 keeps the compaction worker down even when "

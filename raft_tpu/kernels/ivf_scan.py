@@ -54,6 +54,16 @@ from raft_tpu.kernels.toolkit import fold_topk, quantize_queries_i8
 _WORST = float("inf")
 
 
+def pack_list_filter_table(list_index: jax.Array, table: jax.Array):
+    """Pack a whole filter registry for the ragged descriptor leg:
+    ``table`` [F, W_global] global-bitset rows → [F, L, ceil(cap/32)]
+    per-list word tables (``pack_list_filter`` vmapped over the filter
+    axis).  Each query's prefetched ``fid`` then selects its own [L, cap_w]
+    plane inside the kernel, so a batch mixing F different predicates
+    shares one executable."""
+    return jax.vmap(lambda fw: pack_list_filter(list_index, fw))(table)
+
+
 def pack_list_filter(list_index: jax.Array, filter_words: jax.Array):
     """Pack the bitset pass/fail of every (list, slot) into per-list
     uint32 words ([L, ceil(cap/32)]): bit j of word w covers slot
@@ -297,6 +307,14 @@ def _scan_qm_kernel(probes_ref, dec_ref, y2_ref, ids_ref, filt_ref, q_ref,
         out_ids_ref[0] = o
 
 
+def _scan_qm_kernel_fid(probes_ref, fid_ref, *rest, **kw):
+    """Descriptor-leg adapter: with two prefetched scalars (probes, fid)
+    the kernel receives an extra leading ref, but fid only drives the filt
+    BlockSpec index map — the body is byte-identical to the single-filter
+    schedule (the block already arrived selected)."""
+    _scan_qm_kernel(probes_ref, *rest, **kw)
+
+
 #: query-block width of the fused query-major scan — one full sublane set
 _QM_GROUP = 8
 
@@ -343,6 +361,7 @@ def ivf_scan_query_major(
     metric: str = "sqeuclidean",
     scan_dtype: str = "highest",
     list_filter: jax.Array | None = None,  # [L, ceil(cap/32)] uint32
+    query_fid: jax.Array | None = None,    # [Q] int32 — ragged filter ids
     scan_scale: float = 1.0,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -355,6 +374,14 @@ def ivf_scan_query_major(
     the XLA query-major leg pre-postprocess.  Q must be a multiple of
     the group width (pad with q2=+inf rows; their outputs are -1/inf).
 
+    Ragged descriptor leg: with ``query_fid`` (and ``list_filter`` a
+    ``pack_list_filter_table`` [F, L, cap_w] table) each query's filter id
+    rides as a second prefetched scalar that only the filt BlockSpec index
+    map consumes — query i of step (qb, p) DMAs word block
+    ``fid[qb·G+i]·L + probes[...]`` of the flattened [F·L, 1, cap_w]
+    table.  The kernel body is unchanged, so heterogeneous-filter batches
+    keep the fused path with one executable.
+
     VMEM budget: the scratch holds 2·G·P·cap_pad·4 bytes (cap lane-padded
     to a 128 multiple; ``qm_scratch_bytes`` is the owner) — callers gate
     on this (see ivf_pq's dispatch) and fall back to XLA past it."""
@@ -363,6 +390,78 @@ def ivf_scan_query_major(
     G = _QM_GROUP
     if Q % G:
         raise ValueError(f"Q={Q} must be a multiple of {G} (pad upstream)")
+    if query_fid is not None:
+        if list_filter is None or list_filter.ndim != 3:
+            raise ValueError(
+                "query_fid requires a pack_list_filter_table [F, L, cap_w] "
+                "list_filter"
+            )
+        F, _, cap_w = list_filter.shape
+        cap_pad = _cap_pad(cap)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(Q // G, P, G),
+            in_specs=[
+                pl.BlockSpec(       # dec: member i's probe-p list (dynamic)
+                    (1, cap, rot),
+                    lambda qb, p, i, pr, fid: (pr[(qb * G + i) * P + p], 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, cap),
+                    lambda qb, p, i, pr, fid: (pr[(qb * G + i) * P + p], 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, cap),
+                    lambda qb, p, i, pr, fid: (pr[(qb * G + i) * P + p], 0, 0),
+                ),
+                pl.BlockSpec(       # filt: the member's OWN filter plane
+                    (1, 1, cap_w),
+                    lambda qb, p, i, pr, fid: (
+                        fid[qb * G + i] * L + pr[(qb * G + i) * P + p],
+                        0,
+                        0,
+                    ),
+                ),
+                pl.BlockSpec(       # member i's query row
+                    (1, 1, rot), lambda qb, p, i, pr, fid: (qb * G + i, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1), lambda qb, p, i, pr, fid: (qb * G + i, 0, 0)
+                ),
+                pl.BlockSpec(memory_space=pltpu.SMEM),   # scan_scale
+            ],
+            out_specs=[
+                pl.BlockSpec((1, G, kk), lambda qb, p, i, pr, fid: (qb, 0, 0)),
+                pl.BlockSpec((1, G, kk), lambda qb, p, i, pr, fid: (qb, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((G, P, cap_pad), jnp.float32),
+                pltpu.VMEM((G, P, cap_pad), jnp.int32),
+            ],
+        )
+        vals, ids = pl.pallas_call(
+            functools.partial(
+                _scan_qm_kernel_fid, kk=kk, metric=metric, filtered=True,
+                scan_dtype=scan_dtype, P=P, G=G, cap=cap, cap_pad=cap_pad,
+            ),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((Q // G, G, kk), jnp.float32),
+                jax.ShapeDtypeStruct((Q // G, G, kk), jnp.int32),
+            ],
+            interpret=interpret,
+        )(
+            probes.reshape(-1),
+            jnp.asarray(query_fid, jnp.int32).reshape(-1),
+            list_data,
+            list_y2[:, None, :],
+            list_index[:, None, :],
+            list_filter.reshape(F * L, 1, cap_w),
+            q_rot[:, None, :],
+            q2[:, None, None],
+            jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),
+        )
+        return vals.reshape(Q, kk), ids.reshape(Q, kk)
     filtered = list_filter is not None
     if not filtered:
         list_filter = jnp.zeros((L, 1), jnp.uint32)

@@ -236,15 +236,38 @@ def resolve_pass_filter(sample_filter, deleted_mask):
     ``sample_filter`` keeps set bits (ref: sample_filter_types.hpp
     bitset_filter); ``deleted_mask`` EXCLUDES set bits (the serving layer's
     tombstone convention, raft_tpu.serve.mutation).  Returns a single
-    pass-filter Bitset or None.  Both masks must cover the same id space
-    when combined.
+    pass-filter Bitset/RowFilter or None.  Both masks must cover the same
+    id space when combined (a RowFilter may cover a superset — ragged
+    batches filter in the global id space, which extends past the main
+    index rows the tombstones cover; the extra words pass through).
     """
-    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.core.bitset import Bitset, RowFilter
 
     if deleted_mask is None:
         return sample_filter
     if sample_filter is None:
         return Bitset(~deleted_mask.words, deleted_mask.n_bits)
+    if isinstance(sample_filter, RowFilter):
+        if sample_filter.n_bits < deleted_mask.n_bits:
+            raise ValueError(
+                f"row filter covers {sample_filter.n_bits} ids but "
+                f"deleted_mask covers {deleted_mask.n_bits}"
+            )
+        nw = deleted_mask.words.shape[0]
+        live = ~deleted_mask.words
+        words = sample_filter.words.at[:, :nw].set(
+            sample_filter.words[:, :nw] & live[None, :]
+        )
+        table = sample_filter.table
+        if table is not None:
+            table = table.at[:, :nw].set(table[:, :nw] & live[None, :])
+        return RowFilter(
+            words,
+            sample_filter.n_bits,
+            fid=sample_filter.fid,
+            table=table,
+            pass_count=sample_filter.pass_count,
+        )
     if sample_filter.n_bits != deleted_mask.n_bits:
         raise ValueError(
             f"sample_filter covers {sample_filter.n_bits} ids but "
@@ -262,6 +285,20 @@ def invalid_mask(ids: jax.Array, filter_words: Optional[jax.Array]) -> jax.Array
         bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
         invalid = invalid | (bit == 0)
     return invalid
+
+
+def invalid_mask_rows(ids: jax.Array, row_words: jax.Array) -> jax.Array:
+    """Per-row variant of :func:`invalid_mask` for ragged batches: ids
+    [rows, ...] tested against row_words [rows, n_words] — query row r is
+    filtered by its own word set, so heterogeneous predicates share one
+    compiled scan."""
+    r = ids.shape[0]
+    clipped = jnp.clip(ids, 0, None)
+    word = jnp.take_along_axis(
+        row_words, (clipped // 32).reshape(r, -1), axis=1
+    ).reshape(ids.shape)
+    bit = (word >> (clipped % 32).astype(jnp.uint32)) & 1
+    return (ids < 0) | (bit == 0)
 
 
 def centroid_group_inverse(centers) -> np.ndarray:
@@ -393,21 +430,27 @@ def pallas_scan_enabled(
     )
 
 
-def run_query_tiled(run_fn, queries, q_tile: int):
-    """Host-level query batching: run ``run_fn(q_tile_block) → (v, i)``
-    over fixed-size query tiles (tail zero-padded so every call shares one
-    compiled shape) and concatenate. The single tiling implementation for
-    every probe-major/sharded search entry."""
+def run_query_tiled(run_fn, queries, q_tile: int, extras=()):
+    """Host-level query batching: run ``run_fn(q_tile_block, *extra_blocks)
+    → (v, i)`` over fixed-size query tiles (tail zero-padded so every call
+    shares one compiled shape) and concatenate. The single tiling
+    implementation for every probe-major/sharded search entry. ``extras``
+    are per-query arrays (leading dim = n_q, e.g. ragged filter ids) sliced
+    and padded alongside the queries."""
     n_q = queries.shape[0]
     if q_tile >= n_q:
-        return run_fn(queries)
+        return run_fn(queries, *extras)
     vs, is_ = [], []
     for s in range(0, n_q, q_tile):
         qt = queries[s : s + q_tile]
+        ets = [e[s : s + q_tile] for e in extras]
         pad = q_tile - qt.shape[0]
         if pad:
             qt = jnp.pad(qt, ((0, pad), (0, 0)))
-        v, i = run_fn(qt)
+            ets = [
+                jnp.pad(e, [(0, pad)] + [(0, 0)] * (e.ndim - 1)) for e in ets
+            ]
+        v, i = run_fn(qt, *ets)
         vs.append(v[: v.shape[0] - pad] if pad else v)
         is_.append(i[: i.shape[0] - pad] if pad else i)
     return jnp.concatenate(vs), jnp.concatenate(is_)

@@ -59,8 +59,19 @@ def _tiled_knn(
     n_q_tiles = (n_q + query_tile - 1) // query_tile
     pad_q = n_q_tiles * query_tile - n_q
     q_tiles = jnp.pad(queries, ((0, pad_q), (0, 0))).reshape(n_q_tiles, query_tile, d)
+    # per-row filters (ragged batches) tile alongside the queries so each
+    # query row is masked by its own word set; ndim is static in trace
+    per_row = filter_words is not None and filter_words.ndim == 2
+    if per_row:
+        fw_tiles = jnp.pad(filter_words, ((0, pad_q), (0, 0))).reshape(
+            n_q_tiles, query_tile, -1
+        )
+    else:
+        fw_tiles = jnp.zeros((n_q_tiles, 1, 1), jnp.uint32)  # unused carrier
 
-    def per_query_tile(q):
+    def per_query_tile(args):
+        q, fw_t = args
+
         def scan_tile(carry, inp):
             best_v, best_i = carry
             tile, tile_idx = inp
@@ -72,12 +83,15 @@ def _tiled_knn(
                 # post-filter (tombstones / sample filter): excluded rows
                 # take the worst distance and surface as id −1, matching
                 # the IVF family's filtered-candidate contract
-                word = filter_words[jnp.clip(col_ids, 0) // 32]
+                if per_row:
+                    word = fw_t[:, jnp.clip(col_ids, 0) // 32]
+                else:
+                    word = filter_words[jnp.clip(col_ids, 0) // 32][None, :]
                 passing = (
-                    (word >> (col_ids % 32).astype(jnp.uint32)) & 1
-                ).astype(bool) & (col_ids < n)
-                dist = jnp.where(passing[None, :], dist, worst)
-                sel_ids = jnp.where(passing[None, :], sel_ids, -1)
+                    (word >> (col_ids % 32).astype(jnp.uint32)[None, :]) & 1
+                ).astype(bool) & (col_ids < n)[None, :]
+                dist = jnp.where(passing, dist, worst)
+                sel_ids = jnp.where(passing, sel_ids, -1)
             tv, ti = select_k(
                 dist, min(k, tile_cols), select_min=select_min,
                 input_indices=sel_ids,
@@ -96,7 +110,7 @@ def _tiled_knn(
         )
         return vals, idx
 
-    vals, idx = lax.map(per_query_tile, q_tiles)
+    vals, idx = lax.map(per_query_tile, (q_tiles, fw_tiles))
     vals = vals.reshape(n_q_tiles * query_tile, k)[:n_q]
     idx = idx.reshape(n_q_tiles * query_tile, k)[:n_q]
     return vals, idx
@@ -159,6 +173,12 @@ def knn(
             f"filter covers {pass_filter.n_bits} ids but dataset has {n} rows"
         )
     filter_words = None if pass_filter is None else pass_filter.words
+    if filter_words is not None and filter_words.ndim == 2:
+        validation.expects(
+            filter_words.shape[0] == queries.shape[0],
+            f"row filter has {filter_words.shape[0]} rows for "
+            f"{queries.shape[0]} queries",
+        )
 
     # Pallas fused distance+topk path (ref: the fusedL2Knn fast path,
     # spatial/knn/detail/fused_l2_knn-inl.cuh — fuses the distance tile and

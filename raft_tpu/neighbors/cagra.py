@@ -539,16 +539,30 @@ def _search_jit(
     pad = n_tiles * tile - q
     qt = jnp.pad(queries, ((0, pad), (0, 0))).reshape(n_tiles, tile, d)
     st = jnp.pad(seed_ids, ((0, pad), (0, 0))).reshape(n_tiles, tile, -1)
-
-    def filt_inf(ids, dists):
-        if filter_words is None:
-            return dists
-        word = filter_words[jnp.clip(ids, 0, None) // 32]
-        bit = (word >> (jnp.clip(ids, 0, None) % 32).astype(jnp.uint32)) & 1
-        return jnp.where(bit == 0, jnp.inf, dists)
+    # per-row filters (ragged batches) tile alongside the queries; ndim is
+    # static in trace so the branch costs nothing at runtime
+    per_row = filter_words is not None and filter_words.ndim == 2
+    if per_row:
+        ft = jnp.pad(filter_words, ((0, pad), (0, 0))).reshape(
+            n_tiles, tile, -1
+        )
+    else:
+        ft = jnp.zeros((n_tiles, 1, 1), jnp.uint32)  # unused carrier
 
     def one_tile(args):
-        qs, seeds = args                                  # [t, d], [t, s]
+        qs, seeds, fw_t = args                            # [t, d], [t, s]
+
+        def filt_inf(ids, dists):
+            if filter_words is None:
+                return dists
+            safe = jnp.clip(ids, 0, None)
+            if per_row:
+                word = jnp.take_along_axis(fw_t, safe // 32, axis=1)
+            else:
+                word = filter_words[safe // 32]
+            bit = (word >> (safe % 32).astype(jnp.uint32)) & 1
+            return jnp.where(bit == 0, jnp.inf, dists)
+
         # ---- random init (ref: random_samplings init of itopk candidates)
         vecs = _gather_rows(dataset, seeds)
         dists = _query_distance(qs, vecs, metric)
@@ -662,7 +676,7 @@ def _search_jit(
             v = jnp.sqrt(jnp.maximum(v, 0.0))
         return v, i
 
-    vals, idx = lax.map(one_tile, (qt, st))
+    vals, idx = lax.map(one_tile, (qt, st, ft))
     return vals.reshape(-1, k)[:q], idx.reshape(-1, k)[:q]
 
 
@@ -738,6 +752,10 @@ def search(
     per_q = 4 * (width * deg) * (index.dim + 4) + 16 * itopk
     tile = params.max_queries or max(1, min(max(q, 1), res.workspace_rows(per_q, cap=512)))
     fw = sample_filter.words if sample_filter is not None else None
+    if fw is not None and fw.ndim == 2 and fw.shape[0] != q:
+        raise ValueError(
+            f"row filter has {fw.shape[0]} rows for {q} queries"
+        )
     return _search_jit(
         index.dataset, index.graph, queries, fw, seed_ids,
         int(k), int(itopk), int(width), int(max_iter), int(min_iter),

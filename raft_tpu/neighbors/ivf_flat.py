@@ -47,6 +47,7 @@ from raft_tpu.neighbors._common import (
     subsample_trainset,
     coarse_select,
     invalid_mask,
+    invalid_mask_rows,
     default_max_cap,
     merge_split_lists,
     pallas_scan_enabled,
@@ -396,9 +397,18 @@ def _search_jit(
     pad_q = n_tiles * query_tile - q
     qt = jnp.pad(queries, ((0, pad_q), (0, 0))).reshape(n_tiles, query_tile, d)
     pt = jnp.pad(probes, ((0, pad_q), (0, 0))).reshape(n_tiles, query_tile, n_probes)
+    # per-row filters (ragged batches) tile alongside the queries; ndim is
+    # static in trace so the branch costs nothing at runtime
+    per_row = filter_words is not None and filter_words.ndim == 2
+    if per_row:
+        ft = jnp.pad(filter_words, ((0, pad_q), (0, 0))).reshape(
+            n_tiles, query_tile, -1
+        )
+    else:
+        ft = jnp.zeros((n_tiles, 1, 1), jnp.uint32)  # unused carrier
 
     def tile(args):
-        qq, pp = args  # [t, d], [t, p]
+        qq, pp, fw_t = args  # [t, d], [t, p], [t, W]
         data = list_data[pp].astype(jnp.float32)      # [t, p, cap, d] gather
         ids = list_index[pp]                          # [t, p, cap]
         norms = list_norms[pp]                        # [t, p, cap]
@@ -412,7 +422,10 @@ def _search_jit(
             dist = 1.0 - ip / (qn[:, None, None] * vn)
         else:  # sqeuclidean/euclidean: ‖y‖² − 2x·y (+‖x‖² later, rank-stable)
             dist = norms - 2.0 * ip
-        invalid = invalid_mask(ids, filter_words)
+        if per_row:
+            invalid = invalid_mask_rows(ids, fw_t)
+        else:
+            invalid = invalid_mask(ids, filter_words)
         dist = jnp.where(invalid, jnp.inf, dist)
         # filtered-out candidates must surface as id −1, never their real id
         ids = jnp.where(invalid, -1, ids)
@@ -429,7 +442,7 @@ def _search_jit(
             v = v + qq2[:, None]
         return v, i
 
-    vals, idx = lax.map(tile, (qt, pt))
+    vals, idx = lax.map(tile, (qt, pt, ft))
     return (
         vals.reshape(n_tiles * query_tile, k)[:q],
         idx.reshape(n_tiles * query_tile, k)[:q],
@@ -558,13 +571,17 @@ def _search_probe_major_pallas(
 )
 def _search_query_major_pallas(
     queries, centers, list_data, list_index, list_norms, list_filter,
-    n_probes: int, k: int, metric: str, interpret: bool,
+    n_probes: int, k: int, metric: str, interpret: bool, query_fid=None,
 ):
     """Query-major schedule with the fused Pallas scan (payload-agnostic
     kernels/ivf_scan.ivf_scan_query_major — here y² = stored row norms
     and queries ride unrotated): probed lists stream straight into VMEM;
     the XLA leg's [t, p, cap, d] gather copy and score tensor never
-    exist. Queries pad to the kernel group width with q2=+inf rows."""
+    exist. Queries pad to the kernel group width with q2=+inf rows.
+
+    ``query_fid`` (ragged descriptor leg) selects each query's filter row
+    from a pre-packed [n_filters, L, cap_w] ``list_filter`` table; padding
+    rows ride fid 0 — their q2=+inf already voids the result."""
     from raft_tpu.kernels.ivf_scan import _QM_GROUP, ivf_scan_query_major
 
     q, d = queries.shape
@@ -578,10 +595,12 @@ def _search_query_major_pallas(
         probes = jnp.pad(probes, ((0, pad), (0, 0)))
         queries = jnp.pad(queries, ((0, pad), (0, 0)))
         q2 = jnp.pad(q2, (0, pad), constant_values=jnp.inf)
+        if query_fid is not None:
+            query_fid = jnp.pad(query_fid, (0, pad))
     v, i = ivf_scan_query_major(
         probes, queries, q2, list_data, norms, list_index, int(k),
         metric=metric, scan_dtype="highest", list_filter=list_filter,
-        interpret=interpret,
+        query_fid=query_fid, interpret=interpret,
     )
     v, i = v[:q], i[:q]
     if metric == "inner_product":
@@ -626,8 +645,20 @@ def search(
     validation.check_in(
         params.strategy, ("auto", "query_major", "probe_major"), "strategy"
     )
+    per_row = fw is not None and fw.ndim == 2
+    req_strategy = params.strategy
+    if per_row:
+        validation.expects(
+            fw.shape[0] == queries.shape[0],
+            f"row filter has {fw.shape[0]} rows for "
+            f"{queries.shape[0]} queries",
+        )
+        # probe-major tiles score whole lists against query *buckets*; a
+        # per-query filter has no per-list formulation there, so ragged
+        # batches always take the query-major schedule
+        req_strategy = "query_major"
     strategy, bucket, bb, q_tile = select_scan_strategy(
-        params.strategy, queries.shape[0], n_probes, index.n_lists,
+        req_strategy, queries.shape[0], n_probes, index.n_lists,
         index.list_cap, index.dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
@@ -668,12 +699,34 @@ def search(
         return run_query_tiled(run_pm, queries, q_tile)
     from raft_tpu.kernels import ivf_scan as _scan_mod
 
+    has_descriptor = per_row and getattr(sample_filter, "table", None) is not None
     if (
         pallas_scan_enabled(canonical, index.list_data.dtype)
+        and (not per_row or has_descriptor)
         and _scan_mod.qm_scratch_bytes(n_probes, index.list_cap)
         <= _scan_mod.QM_VMEM_BUDGET
     ):
         from raft_tpu.kernels import interpret_mode
+
+        if has_descriptor:
+            # ragged descriptor leg: pack every registered filter's per-list
+            # word table once; each query's fid prefetches its own block
+            lf = _scan_mod.pack_list_filter_table(
+                index.list_index, sample_filter.table
+            )
+            fid = jnp.asarray(sample_filter.fid, jnp.int32)
+
+            def run_qm(qt, ft):
+                return _search_query_major_pallas(
+                    qt, index.centers, index.list_data, index.list_index,
+                    index.list_norms, lf, n_probes, int(k), canonical,
+                    interpret_mode(), query_fid=ft,
+                )
+
+            return run_query_tiled(
+                run_qm, queries, _scan_mod.qm_query_tile(n_probes),
+                extras=(fid,),
+            )
 
         lf = (
             None if fw is None

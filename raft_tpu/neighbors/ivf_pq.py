@@ -66,6 +66,7 @@ from raft_tpu.neighbors._common import (
     coarse_select,
     default_max_cap,
     invalid_mask,
+    invalid_mask_rows,
     merge_split_lists,
     pallas_scan_enabled,
     run_probe_major,
@@ -1049,9 +1050,18 @@ def _search_jit(
     pad_q = n_tiles * query_tile - q
     qt = jnp.pad(q_rot, ((0, pad_q), (0, 0))).reshape(n_tiles, query_tile, rot_dim)
     pt = jnp.pad(probes, ((0, pad_q), (0, 0))).reshape(n_tiles, query_tile, n_probes)
+    # per-row filters (ragged batches) tile alongside the queries; ndim is
+    # static in trace so the branch costs nothing at runtime
+    per_row = filter_words is not None and filter_words.ndim == 2
+    if per_row:
+        ft = jnp.pad(filter_words, ((0, pad_q), (0, 0))).reshape(
+            n_tiles, query_tile, -1
+        )
+    else:
+        ft = jnp.zeros((n_tiles, 1, 1), jnp.uint32)  # unused carrier
 
     def tile(args):
-        qr, pp = args  # [t, rot_dim], [t, p]
+        qr, pp, fw_t = args  # [t, rot_dim], [t, p], [t, W]
         dec = list_data[pp]                              # [t, p, cap, rot]
         ids = list_index[pp]                             # [t, p, cap]
         y2 = list_y2[pp]                                 # [t, p, cap]
@@ -1080,7 +1090,10 @@ def _search_jit(
                 y2.astype(acc_dtype) - 2.0 * ip + q2[:, None, None]
             ).astype(jnp.float32)
 
-        invalid = invalid_mask(ids, filter_words)
+        if per_row:
+            invalid = invalid_mask_rows(ids, fw_t)
+        else:
+            invalid = invalid_mask(ids, filter_words)
         scores = jnp.where(invalid, jnp.inf, scores)
         # filtered-out candidates must surface as id −1, never their real id
         ids = jnp.where(invalid, -1, ids)
@@ -1094,7 +1107,7 @@ def _search_jit(
             v = jnp.sqrt(jnp.maximum(v, 0.0))
         return v, i
 
-    vals, idx = lax.map(tile, (qt, pt))
+    vals, idx = lax.map(tile, (qt, pt, ft))
     return (
         vals.reshape(n_tiles * query_tile, k)[:q],
         idx.reshape(n_tiles * query_tile, k)[:q],
@@ -1318,8 +1331,20 @@ def search(
     validation.check_in(
         params.strategy, ("auto", "query_major", "probe_major"), "strategy"
     )
+    per_row = fw is not None and fw.ndim == 2
+    req_strategy = params.strategy
+    if per_row:
+        validation.expects(
+            fw.shape[0] == queries.shape[0],
+            f"row filter has {fw.shape[0]} rows for "
+            f"{queries.shape[0]} queries",
+        )
+        # probe-major tiles score whole lists against query *buckets*; a
+        # per-query filter has no per-list formulation there, so ragged
+        # batches always take the query-major schedule
+        req_strategy = "query_major"
     strategy, bucket, bb, q_tile = select_scan_strategy(
-        params.strategy, queries.shape[0], n_probes, index.n_lists,
+        req_strategy, queries.shape[0], n_probes, index.n_lists,
         index.list_cap, index.rot_dim, res.workspace_limit_bytes, k=int(k),
     )
     if strategy == "probe_major":
@@ -1373,6 +1398,10 @@ def search(
     if (
         pallas_scan_enabled(canonical, index.list_data.dtype, allow_int8=True)
         and params.internal_distance_dtype == "float32"
+        # per-row filters ride the XLA query-major leg here: ivf_pq's
+        # fused wrapper has no descriptor plumbing yet (ivf_flat's does —
+        # extend it there first, the rotation makes this one hairier)
+        and not per_row
         # the fused kernel's per-block score scratch must fit VMEM
         # comfortably; past that the XLA leg tiles better
         and _scan_mod.qm_scratch_bytes(n_probes, index.list_cap)

@@ -91,6 +91,29 @@ def _select_k_chunked(scores: jax.Array, k: int, select_min: bool):
     return vals.astype(scores.dtype), idx.astype(jnp.int32)
 
 
+def mask_row_k(
+    vals: jax.Array,
+    idx: jax.Array,
+    row_k: jax.Array,
+    *,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Demote result columns past each row's own k: positions ≥ row_k[r]
+    become (worst value, id −1).
+
+    The ragged serving path runs every request at the bucket's static
+    ``k_max`` — per-request ``k`` rides as data, so one executable covers
+    any k mix — and this mask restores per-row semantics before futures
+    slice their own top-k (SNIPPETS idiom: k as operand, not shape)."""
+    kk = vals.shape[-1]
+    pos = jnp.arange(kk, dtype=jnp.int32)
+    keep = pos[None, :] < jnp.asarray(row_k, jnp.int32).reshape(-1, 1)
+    worst = (
+        _min_identity(vals.dtype) if select_min else _max_identity(vals.dtype)
+    )
+    return jnp.where(keep, vals, worst), jnp.where(keep, idx, jnp.int32(-1))
+
+
 @traced("matrix.select_k")
 def select_k(
     scores: jax.Array,
@@ -100,6 +123,7 @@ def select_k(
     input_indices: Optional[jax.Array] = None,
     sorted: bool = True,
     algo: str = "auto",
+    row_k: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched top-k selection (ref: matrix/select_k.cuh API).
 
@@ -115,6 +139,8 @@ def select_k(
       algo: "auto" (heuristic, ref select_k-inl.cuh:47 idea), "topk"
         (single wide ``lax.top_k``), or "chunked" (two-stage tournament,
         the large-n analog of the reference's radix path).
+      row_k: optional [batch] int per-row effective k ≤ k; columns past a
+        row's own k are demoted via :func:`mask_row_k` (ragged batches).
 
     Returns:
       (values [batch, k], indices [batch, k]); indices are int32 positions
@@ -154,6 +180,8 @@ def select_k(
             if input_indices.ndim == 1:
                 input_indices = input_indices[None, :]
             idx = jnp.take_along_axis(input_indices, idx, axis=-1)
+        if row_k is not None:
+            vals, idx = mask_row_k(vals, idx, row_k, select_min=select_min)
         if squeeze:
             return vals[0], idx[0]
         return vals, idx
@@ -180,6 +208,9 @@ def select_k(
         if input_indices.ndim == 1:
             input_indices = input_indices[None, :]
         idx = jnp.take_along_axis(input_indices, idx, axis=-1)
+
+    if row_k is not None:
+        vals, idx = mask_row_k(vals, idx, row_k, select_min=select_min)
 
     if squeeze:
         return vals[0], idx[0]

@@ -22,6 +22,11 @@ indexes that change while being served.  Five pieces:
   worker that folds tombstones + side buffer back into the main
   structure via memory-budgeted shadow rebuilds, recall-gated atomic
   promotion, and zero post-swap recompiles.
+- :mod:`~raft_tpu.serve.ragged` — continuous ragged batching: one packed
+  dispatch per capacity bucket for heterogeneous requests; per-request
+  ``k`` and registered filter ids ride as descriptor *data* instead of
+  executable shapes, retiring the per-(bucket × k × filter) variant
+  lattice (``SearchService(ragged=True)`` / ``RAFT_TPU_RAGGED=1``).
 - :mod:`~raft_tpu.serve.shard` — ``ShardedIndex``: the index itself
   partitioned across the mesh axis (brute-force rows / IVF lists), each
   shard running the existing local search with one cross-shard tie-stable
@@ -43,6 +48,7 @@ from raft_tpu.serve.metrics import (
     install_compile_listener,
 )
 from raft_tpu.serve.mutation import MutableIndex
+from raft_tpu.serve.ragged import FilterRegistry, RaggedSearcher, RaggedSpec
 from raft_tpu.serve.registry import IndexRegistry
 from raft_tpu.serve.replica import (
     ReplicaGroup,
@@ -55,9 +61,12 @@ from raft_tpu.serve.shard import ShardedIndex, shard_index
 __all__ = [
     "CompactionPolicy",
     "Compactor",
+    "FilterRegistry",
     "IndexRegistry",
     "MicroBatcher",
     "MutableIndex",
+    "RaggedSearcher",
+    "RaggedSpec",
     "ReplicaGroup",
     "SearchService",
     "ServingMetrics",

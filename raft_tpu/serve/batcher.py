@@ -47,6 +47,17 @@ ring slot (one of ``pipeline_depth`` per bucket) comes around again its
 previous occupant has fully completed — including the observer call,
 which sees a *copy* of the staged rows precisely because the auditor
 holds samples past the batch's lifetime.
+
+Ragged mode (``ragged=`` a :class:`raft_tpu.serve.ragged.RaggedSpec`):
+heterogeneous requests — each with its own top-``k`` and registered
+filter id — pack into ONE dispatch per capacity bucket.  ``k`` and the
+filter become descriptor *data* (``row_k``/``row_fid`` int32 columns
+alongside the padded queries) instead of executable shapes, collapsing
+the per-(bucket × k × filter) variant lattice the classic mode would
+need.  With the pipeline enabled, admission also turns *continuous*:
+the worker claims the in-flight window slot before cutting the batch,
+so the forming batch keeps admitting submissions for exactly as long as
+the device window is full (see :meth:`MicroBatcher._worker`).
 """
 
 from __future__ import annotations
@@ -64,11 +75,15 @@ import numpy as np
 
 from raft_tpu.core import env as _env
 from raft_tpu.core.trace import trace_range
+from raft_tpu.kernels.toolkit import next_pow2
 from raft_tpu.obs import events as obs_events
 from raft_tpu.obs import flight, slowlog, spans
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
 
-# search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k])
+# search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k]).
+# In ragged mode the signature grows two descriptor columns:
+# (queries [b, dim], row_k [b] int32, row_fid [b] int32) -> same shapes,
+# always at the spec's k_max — per-request k is data, not shape.
 SearchFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
 
 # observer: (queries [n, dim], distances [n, k], ids [n, k]) -> None, called
@@ -77,19 +92,22 @@ SearchFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
 Observer = Callable[[np.ndarray, np.ndarray, np.ndarray], None]
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
+# canonical pow2 helper lives in kernels.toolkit; the old private name is
+# kept because the ladder math below reads naturally with it
+_next_pow2 = next_pow2
 
 
 class _Request:
-    __slots__ = ("rows", "future", "t_submit", "req_id")
+    __slots__ = ("rows", "future", "t_submit", "req_id", "k", "fid")
 
     def __init__(self, rows: np.ndarray, future: Future, t_submit: float,
-                 req_id: int):
+                 req_id: int, k: int = 0, fid: int = 0):
         self.rows = rows
         self.future = future
         self.t_submit = t_submit
         self.req_id = req_id
+        self.k = k        # ragged mode: this request's top-k (<= k_max)
+        self.fid = fid    # ragged mode: registered filter id (0 = all-pass)
 
 
 class _InFlight:
@@ -152,6 +170,14 @@ class MicroBatcher:
         in submission order.  Memory cost: ``pipeline_depth`` staging
         buffers per touched bucket plus the live device buffers of the
         in-flight batches.
+    ragged:
+        Optional :class:`raft_tpu.serve.ragged.RaggedSpec`.  When set,
+        ``search_fn`` takes ``(queries, row_k, row_fid)`` and always
+        computes ``k_max`` result columns; :meth:`submit` accepts
+        per-request ``k``/``fid`` and each future is sliced to its own
+        ``[:k]`` after copy-out.  One executable per capacity bucket —
+        the (bucket × k × filter) variant lattice collapses.  At
+        ``pipeline_depth`` > 1 admission is continuous (see the worker).
     """
 
     def __init__(
@@ -167,6 +193,7 @@ class MicroBatcher:
         observer: Optional[Observer] = None,
         cost_accounting: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
+        ragged=None,
     ):
         if dim <= 0:
             raise ValueError(f"dim must be positive, got {dim}")
@@ -195,6 +222,15 @@ class MicroBatcher:
                 f"pipeline_depth must be >= 1, got {pipeline_depth}"
             )
         self.pipeline_depth = int(pipeline_depth)
+        # ragged mode (a serve.ragged.RaggedSpec, or None for classic):
+        # search_fn takes (queries, row_k, row_fid) and always computes
+        # k_max columns; per-request k/fid ride as data.  Admission turns
+        # continuous at depth > 1: the worker claims the in-flight window
+        # slot BEFORE cutting the batch, so requests keep packing into the
+        # forming batch while the device window is full.
+        self.ragged = ragged
+        if ragged is not None and ragged.k_max < 1:
+            raise ValueError(f"ragged k_max must be >= 1, got {ragged.k_max}")
 
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
@@ -262,7 +298,10 @@ class MicroBatcher:
             for b in self.buckets():
                 dummy = np.zeros((b, self.dim), dtype=np.float32)
                 c0 = compile_count(thread=True)
-                dist, ids = self._search_fn(jax.numpy.asarray(dummy))
+                # ragged mode warms ONE variant per bucket — k and filter
+                # are data, so the dummy descriptor columns cover every
+                # later (k, fid) mix
+                dist, ids = self._invoke(dummy, [])
                 jax.block_until_ready((dist, ids))
                 total += compile_count(thread=True) - c0
                 if self.cost_accounting:
@@ -272,14 +311,59 @@ class MicroBatcher:
         self._warm = True
         return total
 
+    def _invoke(self, padded: np.ndarray, batch: List[_Request]):
+        """Hand one padded bucket to the search fn.
+
+        Ragged mode attaches the per-request descriptor columns: each
+        request's rows carry its ``(k, fid)``; padding rows run at
+        ``k_max`` / filter 0 (all-pass), so the call is the same trace
+        for every batch of this bucket.  Classic mode is the original
+        single-argument call, byte for byte.
+        """
+        if self.ragged is None:
+            return self._search_fn(jax.numpy.asarray(padded))
+        bucket = padded.shape[0]
+        row_k = np.full((bucket,), self.ragged.k_max, np.int32)
+        row_fid = np.zeros((bucket,), np.int32)
+        off = 0
+        for req in batch:
+            m = req.rows.shape[0]
+            row_k[off : off + m] = req.k
+            row_fid[off : off + m] = req.fid
+            off += m
+        return self._search_fn(
+            jax.numpy.asarray(padded),
+            jax.numpy.asarray(row_k),
+            jax.numpy.asarray(row_fid),
+        )
+
+    def _result_view(self, req: _Request, dist: np.ndarray, ids: np.ndarray,
+                     off: int):
+        """This request's slice of a completed batch's host arrays.
+
+        Ragged mode also slices the column axis down to the request's own
+        ``k`` — the executable computed ``k_max`` columns for everyone."""
+        m = req.rows.shape[0]
+        d, i = dist[off : off + m], ids[off : off + m]
+        if self.ragged is not None and req.k < d.shape[1]:
+            d, i = d[:, : req.k], i[:, : req.k]
+        return d, i
+
     def _account_bucket_cost(self, bucket: int, dummy: np.ndarray) -> None:
         """Best-effort XLA cost/memory gauges for one bucket's executable."""
         try:
             from raft_tpu.obs import cost as obs_cost
 
-            report = obs_cost.analyze_callable(
-                self._search_fn, jax.numpy.asarray(dummy)
-            )
+            if self.ragged is None:
+                args = (jax.numpy.asarray(dummy),)
+            else:
+                b = dummy.shape[0]
+                args = (
+                    jax.numpy.asarray(dummy),
+                    jax.numpy.full((b,), self.ragged.k_max, jax.numpy.int32),
+                    jax.numpy.zeros((b,), jax.numpy.int32),
+                )
+            report = obs_cost.analyze_callable(self._search_fn, *args)
             obs_cost.record_cost(
                 report,
                 index=self.metrics.name or "default",
@@ -348,7 +432,8 @@ class MicroBatcher:
         self.stop()
 
     # -- submission ----------------------------------------------------------
-    def submit(self, queries) -> Future:
+    def submit(self, queries, *, k: Optional[int] = None,
+               fid: Optional[int] = None) -> Future:
         """Enqueue one request of shape ``[dim]`` or ``[m, dim]``.
 
         Returns a future resolving to ``(distances [m, k], ids [m, k])``
@@ -357,7 +442,28 @@ class MicroBatcher:
         increasing id as ``fut.request_id`` — the handle that links a
         caller's latency to its flight-recorder timeline and histogram
         exemplar.
+
+        Ragged mode only: ``k`` picks this request's top-k (default and
+        ceiling: the spec's ``k_max``) and ``fid`` a registered filter id
+        (default 0, the all-pass row).  Heterogeneous ``(k, fid)`` mixes
+        pack into one batch — they are descriptor data, not shapes.
         """
+        if self.ragged is None:
+            if k is not None or fid is not None:
+                raise ValueError(
+                    "per-request k/fid need ragged mode — construct the "
+                    "batcher (or SearchService) with ragged="
+                )
+            k, fid = 0, 0
+        else:
+            k = self.ragged.k_max if k is None else int(k)
+            if not 1 <= k <= self.ragged.k_max:
+                raise ValueError(
+                    f"k={k} outside [1, k_max={self.ragged.k_max}]"
+                )
+            fid = 0 if fid is None else int(fid)
+            if fid < 0:
+                raise ValueError(f"fid must be >= 0, got {fid}")
         rows = np.asarray(queries, dtype=np.float32)
         squeeze = rows.ndim == 1
         if squeeze:
@@ -381,9 +487,9 @@ class MicroBatcher:
             inner.add_done_callback(
                 lambda f, out=fut: _squeeze_result(f, out)
             )
-            req = _Request(rows, inner, time.perf_counter(), req_id)
+            req = _Request(rows, inner, time.perf_counter(), req_id, k, fid)
         else:
-            req = _Request(rows, fut, time.perf_counter(), req_id)
+            req = _Request(rows, fut, time.perf_counter(), req_id, k, fid)
         with self._cond:
             if self._stopping and (
                 self._thread is None or not self._thread.is_alive()
@@ -394,9 +500,10 @@ class MicroBatcher:
             self._cond.notify()
         return fut
 
-    def search(self, queries, timeout: Optional[float] = None):
+    def search(self, queries, timeout: Optional[float] = None, *,
+               k: Optional[int] = None, fid: Optional[int] = None):
         """Synchronous convenience wrapper around :meth:`submit`."""
-        fut = self.submit(queries)
+        fut = self.submit(queries, k=k, fid=fid)
         if self._thread is None or not self._thread.is_alive():
             self.flush()
         return fut.result(timeout=timeout)
@@ -443,30 +550,56 @@ class MicroBatcher:
             rows += nxt.rows.shape[0]
         return taken
 
+    def _coalesce_locked(self) -> List[_Request]:
+        """Wait (condition held) for stragglers up to the oldest queued
+        request's deadline, then pop a batch; [] if the queue emptied
+        under us (a racing flush took everything)."""
+        if not self._queue:
+            return []
+        deadline = self._queue[0].t_submit + self.max_delay_s
+        while (
+            sum(r.rows.shape[0] for r in self._queue) < self.max_batch
+            and not self._stopping
+        ):
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            self._cond.wait(timeout=remaining)
+            if not self._queue:
+                return []
+        if not self._queue:
+            return []
+        return self._take_batch_locked()
+
     def _worker(self) -> None:
+        # continuous admission (ragged + pipeline): claim the in-flight
+        # window slot BEFORE cutting the batch.  While a full window
+        # blocks this thread, submit() keeps appending — the eventual
+        # batch packs everything that arrived during the stall instead of
+        # a fixed pre-window cut, so fill rises (and padding waste falls)
+        # exactly when the device is the bottleneck.
+        continuous = self.ragged is not None and self.pipeline_depth > 1
         while True:
             with self._cond:
                 while not self._queue and not self._stopping:
                     self._cond.wait()
                 if self._stopping:
                     return
-                # coalescing window: wait for stragglers, bounded by the
-                # oldest request's deadline
-                deadline = self._queue[0].t_submit + self.max_delay_s
-                while (
-                    sum(r.rows.shape[0] for r in self._queue) < self.max_batch
-                    and not self._stopping
-                ):
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=remaining)
-                    if not self._queue:
-                        break
-                if not self._queue:
+                if not continuous:
+                    # coalescing window: wait for stragglers, bounded by
+                    # the oldest request's deadline
+                    batch = self._coalesce_locked()
+                    if not batch:
+                        continue
+            if continuous:
+                self._inflight_sem.acquire()
+                with self._cond:
+                    batch = self._coalesce_locked()
+                if not batch:
+                    self._inflight_sem.release()
                     continue
-                batch = self._take_batch_locked()
-            if self.pipeline_depth > 1:
+                self._dispatch_pipelined(batch, sem_held=True)
+            elif self.pipeline_depth > 1:
                 self._dispatch_pipelined(batch)
             else:
                 with self._dispatch_lock:
@@ -522,6 +655,12 @@ class MicroBatcher:
                     "queue_ms": (t_pickup - req.t_submit) * 1e3,
                     "latency_ms": (t_done - req.t_submit) * 1e3,
                     "stages_ms": stages_ms,
+                    # ragged descriptor: what this request actually asked
+                    # for inside the packed dispatch
+                    **(
+                        {"k": req.k, "fid": req.fid}
+                        if self.ragged is not None else {}
+                    ),
                 }
                 for req in batch
             ],
@@ -551,7 +690,7 @@ class MicroBatcher:
             with trace_range("serve.batch") as sp:
                 t0 = time.perf_counter()
                 # dispatch: host-side tracing + enqueue of the executable
-                dist, ids = self._search_fn(jax.numpy.asarray(padded))
+                dist, ids = self._invoke(padded, batch)
                 t1 = time.perf_counter()
                 err_stage = "device"
                 # device: waiting for the result to materialize — the serial
@@ -589,9 +728,8 @@ class MicroBatcher:
         off = 0
         lats = []
         for req in batch:
-            m = req.rows.shape[0]
-            req.future.set_result((dist[off : off + m], ids[off : off + m]))
-            off += m
+            req.future.set_result(self._result_view(req, dist, ids, off))
+            off += req.rows.shape[0]
             lats.append(done - req.t_submit)
         observer = self.observer
         if observer is not None:
@@ -695,20 +833,27 @@ class MicroBatcher:
         self._completion_thread = t
         t.start()
 
-    def _dispatch_pipelined(self, batch: List[_Request]
-                            ) -> Optional[_InFlight]:
+    def _dispatch_pipelined(self, batch: List[_Request], *,
+                            sem_held: bool = False) -> Optional[_InFlight]:
         """Stage 1+2: pad into a staging buffer, enqueue device work, hand
         the record to the completion thread.  Never blocks on the device;
         blocks only on the in-flight window (``inflight_wait``).  Returns
         the in-flight record, or None for an empty batch or a dispatch-
-        stage failure (which fails only this batch's futures)."""
+        stage failure (which fails only this batch's futures).
+
+        ``sem_held``: the continuous-admission worker already claimed the
+        window slot before forming the batch — its wait overlapped
+        admission, so this path records ``inflight_wait`` 0."""
         if not batch:
+            if sem_held:
+                self._inflight_sem.release()
             return None
         t_arrive = time.perf_counter()
-        # acquire the window slot BEFORE the dispatch lock: a full window
-        # must stall this dispatcher without also blocking the completion
-        # thread's progress (it never takes either)
-        self._inflight_sem.acquire()
+        if not sem_held:
+            # acquire the window slot BEFORE the dispatch lock: a full
+            # window must stall this dispatcher without also blocking the
+            # completion thread's progress (it never takes either)
+            self._inflight_sem.acquire()
         t_acquired = time.perf_counter()
         with self._dispatch_lock:
             rec = _InFlight(batch)
@@ -737,7 +882,7 @@ class MicroBatcher:
             try:
                 c0 = compile_count(thread=True)
                 t1 = time.perf_counter()
-                dist, ids = self._search_fn(jax.numpy.asarray(padded))
+                dist, ids = self._invoke(padded, batch)
                 t2 = time.perf_counter()
                 rec.t_dispatch = t2 - t1
                 # compiles happen synchronously at trace/enqueue time, so
@@ -846,9 +991,8 @@ class MicroBatcher:
         off = 0
         lats = []
         for req in batch:
-            m = req.rows.shape[0]
-            req.future.set_result((dist[off : off + m], ids[off : off + m]))
-            off += m
+            req.future.set_result(self._result_view(req, dist, ids, off))
+            off += req.rows.shape[0]
             lats.append(done - req.t_submit)
         observer = self.observer
         if observer is not None:

@@ -124,6 +124,10 @@ class ServingMetrics:
         self.warmup_compiles = 0   # compiles spent in explicit warmup
         self._fill_real = 0        # sum of real rows over all batches
         self._fill_padded = 0      # sum of padded bucket rows
+        self._pad_waste = 0        # sum of (bucket - real) padding rows
+        # bucket → [real rows, padded rows]: per-capacity-bucket fill, the
+        # figure that shows where the pad ladder's waste concentrates
+        self._bucket_fill: Dict[int, list] = {}
         self._queue_depth = 0      # rows queued at the last dispatch
         self._pipeline_depth = 1   # in-flight window size (1 = serial)
         self._inflight = 0         # device batches currently in flight
@@ -165,6 +169,10 @@ class ServingMetrics:
             self.recompiles += compiles
             self._fill_real += n_real_rows
             self._fill_padded += bucket_rows
+            self._pad_waste += max(0, bucket_rows - n_real_rows)
+            fill = self._bucket_fill.setdefault(int(bucket_rows), [0, 0])
+            fill[0] += n_real_rows
+            fill[1] += bucket_rows
             for lat in latencies_s:
                 self._latencies.append(lat)
                 self._done_ts.append(now)
@@ -175,11 +183,11 @@ class ServingMetrics:
                     )
                     for v in vals:
                         dq.append(float(v))
-        self._mirror_batch(n_real_rows, latencies_s, compiles, stages,
-                           request_ids)
+        self._mirror_batch(n_real_rows, bucket_rows, latencies_s, compiles,
+                           stages, request_ids)
 
-    def _mirror_batch(self, n_real_rows, latencies_s, compiles, stages,
-                      request_ids=None) -> None:
+    def _mirror_batch(self, n_real_rows, bucket_rows, latencies_s, compiles,
+                      stages, request_ids=None) -> None:
         """Feed the obs registry (no-op for anonymous instances)."""
         if self.name is None:
             return
@@ -206,6 +214,12 @@ class ServingMetrics:
             # OpenMetrics scrape links the bucket to a flight-recorder entry
             ex = f"req-{ids[i]}" if ids is not None and i < len(ids) else None
             lat_h.observe(lat, exemplar=ex, **label)
+        reg.counter(
+            "raft_tpu_serve_pad_waste_rows",
+            help="padding rows dispatched but never asked for (bucket "
+                 "minus real rows) — the pad ladder's tax; ragged "
+                 "continuous admission exists to push this down",
+        ).inc(max(0, bucket_rows - n_real_rows), **label)
         if stages:
             st_h = reg.histogram(
                 "raft_tpu_serve_stage_seconds",
@@ -214,6 +228,14 @@ class ServingMetrics:
             for s, vals in stages.items():
                 for v in vals:
                     st_h.observe(v, stage=s, **label)
+            queue = [float(v) for v in stages.get("queue", ())]
+            if queue:
+                reg.gauge(
+                    "raft_tpu_serve_admit_wait_seconds",
+                    help="mean submit-to-batch admission wait of the last "
+                         "dispatched batch (continuous admission widens "
+                         "this only while the device window is full)",
+                ).set(sum(queue) / len(queue), **label)
 
     def record_error(self, cause: str, count: int = 1) -> None:
         """``count`` requests failed at stage ``cause`` (``"dispatch"``:
@@ -297,6 +319,12 @@ class ServingMetrics:
                     if self._fill_padded
                     else None
                 ),
+                "pad_waste_rows": self._pad_waste,
+                # per-capacity-bucket fill (str keys: JSON-safe)
+                "bucket_fill": {
+                    str(b): (f[0] / f[1] if f[1] else None)
+                    for b, f in sorted(self._bucket_fill.items())
+                },
             }
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50) * 1e3)

@@ -40,11 +40,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.bitset import Bitset, RowFilter
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.trace import trace_range, traced
 from raft_tpu.distance import DISTANCE_TYPES
-from raft_tpu.ops.matrix import select_k
+from raft_tpu.kernels.toolkit import next_pow2
+from raft_tpu.ops.matrix import mask_row_k, select_k
 
 KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
 
@@ -70,8 +71,9 @@ def _infer_kind(index) -> str:
     return mod
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
+# canonical pow2 helper lives in kernels.toolkit; the private alias stays
+# importable (compactor sizes its shadow side buffers through it)
+_next_pow2 = next_pow2
 
 
 def _bitset_from_np(mask: np.ndarray) -> Bitset:
@@ -361,20 +363,58 @@ class MutableIndex:
         with self._lock:
             return self._snapshot_cache
 
-    def _main_search(self, queries, k, tombstones):
+    def _main_search(self, queries, k, tombstones, sample_filter=None):
         mod = _kind_module(self.kind)
         if self.kind == "brute_force":
-            return mod.search(self.index, queries, k, deleted_mask=tombstones)
+            return mod.search(
+                self.index, queries, k,
+                deleted_mask=tombstones, sample_filter=sample_filter,
+            )
         return mod.search(
             self.search_params, self.index, queries, k,
-            deleted_mask=tombstones,
+            deleted_mask=tombstones, sample_filter=sample_filter,
         )
 
-    def search(self, queries, k: int) -> Tuple[jax.Array, jax.Array]:
+    def _side_passes(self, snap: _Snapshot, sample_filter):
+        """Slot-space view of ``sample_filter`` for the side-buffer scan.
+
+        The caller's filter is keyed by *global* ids; the side scan tests
+        *slot* positions.  Gather each slot's bit through ``side_ids`` and
+        AND with slot liveness.  Ids past the filter's bit range pass —
+        a filter constrains only ids it covers, and upserted rows get ids
+        allocated past any pre-registered filter's range.
+        """
+        if sample_filter is None:
+            return snap.side_live
+        live = snap.side_live.to_mask()       # [cap] bool
+        sid = jnp.clip(snap.side_ids, 0)      # dead slots (-1) die via live
+        in_range = snap.side_ids < jnp.int32(sample_filter.n_bits)
+        word_ix = jnp.clip(sid // 32, 0, sample_filter.words.shape[-1] - 1)
+        bit_ix = (sid % 32).astype(jnp.uint32)
+        if isinstance(sample_filter, RowFilter):
+            bit = (
+                sample_filter.words[:, word_ix] >> bit_ix[None, :]
+            ) & jnp.uint32(1)
+            mask = jnp.where(in_range[None, :], bit == 1, True) & live[None, :]
+            return RowFilter.from_mask_rows(mask)
+        bit = (sample_filter.words[word_ix] >> bit_ix) & jnp.uint32(1)
+        return Bitset.from_mask(jnp.where(in_range, bit == 1, True) & live)
+
+    def search(self, queries, k: int, *, sample_filter=None,
+               row_k=None) -> Tuple[jax.Array, jax.Array]:
         """Merged top-k over main (tombstone-filtered) + side buffer.
 
         Returns (distances [q, k], ids [q, k]); pruned/padding slots are
         id −1 at the worst distance, like the backend searches.
+
+        ``sample_filter`` (a :class:`~raft_tpu.core.bitset.Bitset`, or a
+        :class:`~raft_tpu.core.bitset.RowFilter` with one pass-row per
+        query — the ragged path's form) restricts results by global id;
+        it composes with tombstones inside the main search and is remapped
+        to slot space for the side scan.  ``row_k`` (``[q] int32``) caps
+        each row's results below ``k`` as *data* — positions past a row's
+        own k surface as id −1 at the worst distance, with no new
+        executable per distinct k.
         """
         queries = jnp.asarray(queries, jnp.float32)
         if queries.ndim != 2 or queries.shape[1] != self.dim:
@@ -382,8 +422,19 @@ class MutableIndex:
                 f"queries shape {queries.shape} vs index dim {self.dim}"
             )
         snap = self._snapshot()
+        if sample_filter is not None and snap.main_ids is not None:
+            raise NotImplementedError(
+                "sample_filter over a compacted index: filters are keyed "
+                "by global ids but the backend filters its dense stored "
+                "rows — remapping would need a [q, main_rows] intermediate "
+                "per batch.  Serve ragged filters and compaction on "
+                "different indexes for now."
+            )
+        select_min = DISTANCE_TYPES[self.metric] != "inner_product"
         with trace_range("serve.mutable_search"):
-            dist, ids = self._main_search(queries, k, snap.tombstones)
+            dist, ids = self._main_search(
+                queries, k, snap.tombstones, sample_filter
+            )
             if snap.main_ids is not None:
                 # compacted index: the backend returned dense row ids;
                 # remap to the global ids callers know (-1 stays -1)
@@ -391,6 +442,10 @@ class MutableIndex:
                     ids >= 0, snap.main_ids[jnp.clip(ids, 0)], -1
                 )
             if snap.side_data is None:
+                if row_k is not None:
+                    dist, ids = mask_row_k(
+                        dist, ids, row_k, select_min=select_min
+                    )
                 return dist, ids
             from raft_tpu.neighbors import brute_force
 
@@ -398,11 +453,11 @@ class MutableIndex:
             k_side = min(k, cap)
             s_dist, s_slot = brute_force.knn(
                 snap.side_data, queries, k_side,
-                metric=self.metric, sample_filter=snap.side_live,
+                metric=self.metric,
+                sample_filter=self._side_passes(snap, sample_filter),
             )
             # slot → global id (-1 stays -1)
             s_ids = jnp.where(s_slot >= 0, snap.side_ids[s_slot], -1)
-            select_min = DISTANCE_TYPES[self.metric] != "inner_product"
             return select_k(
                 jnp.concatenate([dist, s_dist], axis=1),
                 k,
@@ -410,6 +465,7 @@ class MutableIndex:
                 input_indices=jnp.concatenate(
                     [ids.astype(jnp.int32), s_ids.astype(jnp.int32)], axis=1
                 ),
+                row_k=row_k,
             )
 
     # -- maintenance ---------------------------------------------------------

@@ -34,6 +34,7 @@ import threading
 from typing import Dict, Optional, Sequence, Union
 
 from raft_tpu import obs
+from raft_tpu.core import env as _env
 from raft_tpu.core.trace import traced
 from raft_tpu.obs import cost as obs_cost
 from raft_tpu.obs import health as obs_health
@@ -44,6 +45,7 @@ from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
 from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
+from raft_tpu.serve.ragged import FilterRegistry, RaggedSearcher, RaggedSpec
 from raft_tpu.serve.registry import IndexRegistry
 from raft_tpu.serve.replica import ReplicaGroup
 from raft_tpu.serve.shard import ShardedIndex
@@ -69,6 +71,7 @@ class SearchService:
         slo: Union[
             None, bool, Sequence[obs_slo.SloSpec], obs_slo.SloEngine
         ] = None,
+        ragged: Union[None, bool, RaggedSpec] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -86,6 +89,24 @@ class SearchService:
         # None defers to the batcher's RAFT_TPU_PIPELINE_DEPTH / default;
         # 1 forces the serial dispatch path for every served index
         self.pipeline_depth = pipeline_depth
+        # ragged=None: RAFT_TPU_RAGGED decides.  True: spec from env.
+        # A RaggedSpec is used as-is.  When set, every added index serves
+        # through one packed heterogeneous dispatch per capacity bucket —
+        # per-request k (<= spec.k_max) and registered filter ids ride as
+        # descriptor data, not shapes (see raft_tpu.serve.ragged).
+        if ragged is None:
+            ragged = _env.env_bool("RAFT_TPU_RAGGED", False)
+        if ragged is True:
+            ragged = RaggedSpec.from_env()
+        elif ragged is False:
+            ragged = None
+        self.ragged: Optional[RaggedSpec] = ragged
+        if self.ragged is not None and replicas is not None:
+            raise NotImplementedError(
+                "ragged mode and replica dispatch are mutually exclusive: "
+                "the replica path has no descriptor-column leg yet"
+            )
+        self._filter_regs: Dict[str, Optional[FilterRegistry]] = {}
         self._start = start
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -142,13 +163,29 @@ class SearchService:
         """
         if not isinstance(index, (MutableIndex, ShardedIndex)):
             index = MutableIndex(index)
-        version = self.registry.register(name, index)
         k = self.k if k is None else int(k)
+        if self.ragged is not None and k > self.ragged.k_max:
+            raise ValueError(
+                f"default k={k} exceeds the ragged spec's k_max="
+                f"{self.ragged.k_max}"
+            )
+        version = self.registry.register(name, index)
         with self._lock:
             self._ks[name] = k
             old = self._batchers.pop(name, None)
+            if self.ragged is not None:
+                freg = None
+                if self.ragged.filters and isinstance(index, MutableIndex):
+                    # filter id space: the main index's global ids.  Side
+                    # rows upserted later get ids past this range and pass
+                    # every filter (uncovered = unconstrained).
+                    freg = FilterRegistry(max(1, index.main_size))
+                self._filter_regs[name] = freg
+                search_fn = RaggedSearcher(self, name, self.ragged, freg)
+            else:
+                search_fn = self._make_search_fn(name, k)
             batcher = MicroBatcher(
-                self._make_search_fn(name, k),
+                search_fn,
                 index.dim,
                 min_bucket=self.min_bucket,
                 max_batch=self.max_batch,
@@ -158,6 +195,7 @@ class SearchService:
                 observer=self._make_observer(name),
                 cost_accounting=self.cost_accounting,
                 pipeline_depth=self.pipeline_depth,
+                ragged=self.ragged,
             )
             self._batchers[name] = batcher
         if old is not None:
@@ -232,10 +270,35 @@ class SearchService:
         """The live index (for upsert/delete — visible to the next batch)."""
         return self.registry.get(name)
 
+    def register_filter(self, name: str, mask) -> int:
+        """Register a sample filter for ragged serving; returns its fid.
+
+        ``mask`` is a bool array (or :class:`~raft_tpu.core.bitset.Bitset`)
+        over ``name``'s global id space; requests pass the returned fid to
+        :meth:`submit`/:meth:`search`.  Register before :meth:`warmup` —
+        the table gather is host-side so registration never changes an XLA
+        trace, but cagra's pinned search width and the fused Pallas leg
+        key on filter-derived host values and would spend one compile per
+        bucket on the next dispatch (reported as ``hot_recompile``).
+        """
+        if self.ragged is None:
+            raise RuntimeError(
+                "register_filter needs SearchService(ragged=...)"
+            )
+        with self._lock:
+            freg = self._filter_regs.get(name)
+        if freg is None:
+            raise RuntimeError(
+                f"no filter registry for {name!r}: the index is not "
+                "filterable (ShardedIndex) or the spec has filters=False"
+            )
+        return freg.register(mask)
+
     def remove_index(self, name: str) -> None:
         with self._lock:
             batcher = self._batchers.pop(name)
             self._ks.pop(name, None)
+            self._filter_regs.pop(name, None)
         batcher.stop()
         self.registry.unregister(name)
         if self.slo_engine is not None and self._slo_auto:
@@ -249,14 +312,47 @@ class SearchService:
         with self._lock:
             return self._batchers[name]
 
-    def submit(self, name: str, queries):
-        """Async search; returns a Future of (distances, ids)."""
-        return self._batcher(name).submit(queries)
+    def _ragged_args(self, name: str, k: Optional[int], fid: Optional[int]):
+        """Validate and default the per-request ragged descriptor."""
+        if self.ragged is None:
+            if k is not None or fid is not None:
+                raise ValueError(
+                    "per-request k/fid need SearchService(ragged=...)"
+                )
+            return None, None
+        if k is None:
+            with self._lock:
+                k = self._ks[name]
+        if fid is not None and fid != 0:
+            with self._lock:
+                freg = self._filter_regs.get(name)
+            if freg is None or not freg.contains(fid):
+                raise ValueError(
+                    f"fid {fid} is not registered for {name!r} "
+                    "(register_filter returns valid fids)"
+                )
+        return k, fid
+
+    def submit(self, name: str, queries, *, k: Optional[int] = None,
+               fid: Optional[int] = None):
+        """Async search; returns a Future of (distances, ids).
+
+        Ragged mode only: ``k`` (defaults to the index's configured k,
+        ceiling ``spec.k_max``) and ``fid`` (a :meth:`register_filter`
+        handle; 0/None = unfiltered) shape THIS request inside the packed
+        batch — heterogeneous mixes coalesce into one dispatch.
+        """
+        k, fid = self._ragged_args(name, k, fid)
+        return self._batcher(name).submit(queries, k=k, fid=fid)
 
     @traced("serve.search")
-    def search(self, name: str, queries, timeout: Optional[float] = None):
+    def search(self, name: str, queries, timeout: Optional[float] = None,
+               *, k: Optional[int] = None, fid: Optional[int] = None):
         """Sync search through the batcher (coalesces with live traffic)."""
-        return self._batcher(name).search(queries, timeout=timeout)
+        k, fid = self._ragged_args(name, k, fid)
+        return self._batcher(name).search(
+            queries, timeout=timeout, k=k, fid=fid
+        )
 
     @traced("serve.warmup")
     def warmup(self, name: Optional[str] = None) -> int:

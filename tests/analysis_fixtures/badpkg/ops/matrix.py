@@ -1,0 +1,19 @@
+"""RECOMPILE descriptor seeds: the qualname suffix ``ops.matrix.mask_row_k``
+matches :data:`raft_tpu.analysis.checkers.recompile.DESCRIPTOR_ENTRIES`, so
+``row_k`` is held to jit discipline here even without a @jax.jit decorator.
+"""
+
+import jax.numpy as jnp
+
+
+def mask_row_k(vals, idx, row_k, select_min=True):
+    if row_k[0] > 0:  # branches on the descriptor column's value
+        return vals, idx
+    return vals * 0, idx
+
+
+def select_k(vals, k, row_k=None):
+    # negative control: `is None` tests pytree structure, stays quiet
+    if row_k is None:
+        return vals
+    return jnp.sort(vals)[:, :k]

@@ -423,6 +423,49 @@ def test_compact_churn_smoke_against_frozen_record(tmp_path):
 
 
 @pytest.mark.slow
+def test_ragged_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the ragged-batching A/B: run ``bench.py ragged``
+    (one ragged batcher vs the classic per-(k, filter) variant ladder
+    under identical mixed-k/mixed-filter closed-loop traffic) and gate
+    it with ``bench.py compare`` against the frozen record.  The run
+    must clear the acceptance bars: ragged QPS ≥ 1.3x the ladder arm
+    with equal or lower p99, zero post-warmup recompiles on both arms,
+    and the warmup executable-variant count reduced ≥ 4x."""
+    candidate = str(tmp_path / "ragged_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "ragged"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "ragged leg recompiled on the hot path"
+    ladder, ragged = line["arms"]["ladder"], line["arms"]["ragged"]
+    assert line["qps_vs_ladder"] >= 1.3, (
+        f"ragged arm showed no win: {line['qps_vs_ladder']}x"
+    )
+    assert ragged["p99_ms"] <= ladder["p99_ms"], (
+        "ragged arm worsened tail latency"
+    )
+    assert line["warmup_variant_reduction"] >= 4, (
+        f"executable lattice only shrank {line['warmup_variant_reduction']}x"
+    )
+    assert ragged["pad_waste_rows"] < ladder["pad_waste_rows"]
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_ragged_r11.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
 def test_slo_engine_overhead_smoke_against_frozen_record(tmp_path):
     """CI smoke for the SLO-engine A/B: run ``bench.py slo`` (pooled
     interleaved rounds, background evaluator on a 200 ms tick vs no

@@ -77,8 +77,9 @@ def test_discovery_is_not_vacuous(clean_result):
     assert stats["lockorder_locks"] >= 10, stats
     assert stats["envreg_known_vars"] >= 30, stats
     assert stats["traced_entry_points"] >= 25, stats
-    assert stats["traced_serve_entries_checked"] == 11, stats
+    assert stats["traced_serve_entries_checked"] == 12, stats
     assert stats["traced_batcher_classes"] == 1, stats
+    assert stats["recompile_descriptor_entries"] == 4, stats
 
 
 # -- every rule fires on the seeded fixture ---------------------------------
@@ -96,8 +97,15 @@ def test_recompile_rule(fixture_result):
     symbols = {f.symbol for f in findings}
     assert "badpkg.jits.gate" in symbols, findings
     assert "badpkg.jits.inner" in symbols, findings  # mutable closure
+    # descriptor-path discipline: no @jax.jit on the def, but the ragged
+    # row_k column is still held to jit rules by qualname suffix
+    assert "badpkg.ops.matrix.mask_row_k" in symbols, findings
     # static_argnames negative control must stay quiet
     assert not any("gate_static" in f.symbol for f in findings), findings
+    # `row_k is None` structure test is a laundered negative control
+    assert not any(
+        f.symbol == "badpkg.ops.matrix.select_k" for f in findings
+    ), findings
     assert any(s.symbol == "badpkg.jits.concretize" for s in suppressed), (
         suppressed
     )
