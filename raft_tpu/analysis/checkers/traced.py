@@ -60,6 +60,10 @@ SERVE_ENTRY_POINTS = {
     ("serve.compactor.Compactor", "abort"): "serve.compact.abort",
     ("obs.slo.SloEngine", "evaluate_once"): "slo.evaluate",
     ("obs.incidents.IncidentManager", "handle_event"): "incidents.ingest",
+    ("serve.overload.AdmissionController", "decide"):
+        "serve.admission.decide",
+    ("serve.overload.DegradedModeManager", "step"): "serve.degrade.step",
+    ("serve.overload.HedgedDispatcher", "dispatch"): "serve.hedge.dispatch",
 }
 
 
@@ -284,9 +288,9 @@ def _check_batcher_plumbing(project: Project, result) -> None:
 
         # ragged descriptor plumbing: per-request k/fid must ride the
         # dispatch as data columns and land in flight records
-        require("_invoke", "row_k",
+        require("_invoke_args", "row_k",
                 "ragged dispatches must pass the per-request k column")
-        require("_invoke", "row_fid",
+        require("_invoke_args", "row_fid",
                 "ragged dispatches must pass the per-request filter-id "
                 "column")
         require("_record_flight", "fid",
@@ -294,6 +298,18 @@ def _check_batcher_plumbing(project: Project, result) -> None:
         require("_worker", "sem_held",
                 "continuous admission claims the in-flight slot before "
                 "cutting the batch")
+
+        # overload plumbing: every batch cut must pass through the
+        # admission gate (shed/expire decisions are made at cut time,
+        # not at submit), and priority/deadline must enter at submit
+        require("submit", "priority",
+                "requests must carry their priority class from submit")
+        require("submit", "deadline",
+                "requests must carry their absolute deadline from submit")
+        for path in ("_worker", "flush"):
+            require(path, "_admit",
+                    "every batch cut must pass the admission gate "
+                    "(deadline expiry + priority shedding)")
 
         # _Request.__slots__ must carry req_id so ids cross the queue,
         # and the ragged descriptor fields k / fid alongside it
@@ -309,6 +325,10 @@ def _check_batcher_plumbing(project: Project, result) -> None:
                 ("req_id", "request ids cannot cross the queue"),
                 ("k", "per-request k cannot cross the queue"),
                 ("fid", "per-request filter ids cannot cross the queue"),
+                ("priority", "priority classes cannot cross the queue — "
+                 "admission would shed blind"),
+                ("deadline", "deadlines cannot cross the queue — expired "
+                 "work would dispatch anyway"),
             ):
                 if slot in slots:
                     continue

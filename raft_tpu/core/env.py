@@ -73,6 +73,32 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
            "0 drops the per-request filter-id column from ragged "
            "dispatches (skips the RowFilter gather when no filters are "
            "registered)"),
+    EnvVar("RAFT_TPU_OVERLOAD", "bool", "unset",
+           "1 installs the overload actuators (admission control + "
+           "degraded-mode search) on every SearchService index"),
+    EnvVar("RAFT_TPU_OVERLOAD_ADMIT_WAIT_S", "float", "0.25",
+           "oldest queued request wait that counts as pressure level 1 "
+           "at batch cut (each doubling adds a level)"),
+    EnvVar("RAFT_TPU_OVERLOAD_QUEUE_FACTOR", "float", "8.0",
+           "queue depth in units of max_batch that counts as pressure "
+           "level 1 (each doubling adds a level)"),
+    EnvVar("RAFT_TPU_OVERLOAD_DEGRADE_AFTER_S", "float", "1.0",
+           "sustained pressure before the degraded-search level steps "
+           "up one notch"),
+    EnvVar("RAFT_TPU_OVERLOAD_RESTORE_AFTER_S", "float", "5.0",
+           "sustained calm before the degraded-search level steps back "
+           "down one notch (hysteresis against flapping)"),
+    EnvVar("RAFT_TPU_OVERLOAD_MAX_DEGRADE", "int", "2",
+           "deepest degraded-search level (each level halves n_probes / "
+           "itopk_size; every level's executables are warmed)"),
+    EnvVar("RAFT_TPU_OVERLOAD_HEDGE", "bool", "unset",
+           "1 hedges priority-0 dispatches across replica-group members "
+           "(requires SearchService(replicas=...))"),
+    EnvVar("RAFT_TPU_OVERLOAD_HEDGE_MULT", "float", "3.0",
+           "hedge delay as a multiple of the live p99 latency"),
+    EnvVar("RAFT_TPU_OVERLOAD_HEDGE_MIN_S", "float", "0.005",
+           "hedge delay floor in seconds (used verbatim before the "
+           "latency reservoir has data)"),
     # -- compaction ----------------------------------------------------------
     EnvVar("RAFT_TPU_COMPACT_DISABLED", "bool", "unset",
            "1 keeps the compaction worker down even when "

@@ -21,7 +21,11 @@ unknown kind raises, so the vocabulary stays greppable and the docs
 stay honest.  ``TRIGGER_KINDS`` marks the subset that *starts* an
 incident (and a flight dump); the rest are context that only annotates
 one already open (a ``registry_swap`` during a quality incident tells
-the story, but a routine hot-swap is not itself an incident).
+the story, but a routine hot-swap is not itself an incident).  The
+overload actuators (:mod:`raft_tpu.serve.overload`) publish
+``admission_shed`` and ``degraded_enter`` as triggers — shedding work
+or reducing search effort is an incident-worthy decision — while
+``degraded_exit`` and ``hedge_fired`` are context.
 
 Delivery is synchronous on the publisher's thread — every current
 producer sits on an error/alarm/maintenance path where the old code
@@ -61,6 +65,10 @@ KINDS = frozenset({
     "registry_swap",
     "batch_error",
     "slo_burn",
+    "admission_shed",
+    "degraded_enter",
+    "degraded_exit",
+    "hedge_fired",
 })
 
 #: kinds that open incidents / trigger flight dumps; the rest are context
@@ -71,6 +79,8 @@ TRIGGER_KINDS = frozenset({
     "batch_error",
     "compaction_abort",
     "slo_burn",
+    "admission_shed",
+    "degraded_enter",
 })
 
 #: default recent-events ring capacity

@@ -71,6 +71,9 @@ class IndexProbe:
     compaction_backlog: Optional[int] = None   # pending deletes + side rows
     compaction_trigger: Optional[int] = None   # rows at which a pass fires
     compaction_last_abort: Optional[str] = None  # unresolved abort reason
+    # overload actuators (None: no admission controller / degraded manager)
+    admission_level: Optional[int] = None      # current shed pressure level
+    degraded_level: Optional[int] = None       # current reduced-effort level
 
 
 def _check(status: str, detail: str) -> Dict[str, str]:
@@ -171,6 +174,22 @@ def index_health(probe: IndexProbe) -> Dict[str, object]:
         checks["compaction"] = _check(
             OK, f"compaction backlog {probe.compaction_backlog}"
         )
+
+    # overload: a non-zero actuator level is DEGRADED by design — the
+    # service is *choosing* reduced work (shedding or cheaper search) to
+    # protect p0 latency.  Never UNHEALTHY: that's what the actuators
+    # exist to prevent, and an UNHEALTHY verdict would pull the replica
+    # from rotation and dump its load on the others mid-overload.
+    if probe.admission_level is None and probe.degraded_level is None:
+        checks["overload"] = _check(OK, "no overload controller attached")
+    elif (probe.admission_level or 0) or (probe.degraded_level or 0):
+        checks["overload"] = _check(
+            DEGRADED,
+            f"shedding at level {probe.admission_level or 0}, "
+            f"degraded search level {probe.degraded_level or 0}",
+        )
+    else:
+        checks["overload"] = _check(OK, "no pressure; full-effort search")
 
     status = worst(*(c["status"] for c in checks.values()))
     return {"status": status, "checks": checks}

@@ -15,7 +15,12 @@ open incident opens one (bounded table, ``RAFT_TPU_INCIDENT_MAX_OPEN``;
 overflow is counted, not queued — an incident flood is itself one
 incident).  Context events (``registry_swap``,
 ``compaction_{trigger,promote}``) only annotate an already-open
-timeline.  Recovery edges (``recovered=True``) stamp the incident;
+timeline.  The overload kinds split the same way: ``admission_shed``
+and ``degraded_enter`` are triggers (requests were rejected / effort
+was cut — each opens or joins an incident, so every shed decision is
+inside a correlated timeline), while ``degraded_exit`` and
+``hedge_fired`` only annotate (recovery and routine tail-trimming are
+evidence, not pages).  Recovery edges (``recovered=True``) stamp the incident;
 sustained quiet (``RAFT_TPU_INCIDENT_AUTOCLOSE_S`` with no correlated
 event) closes it — resolution ``"recovered"`` when a recovery edge was
 seen, ``"quiet"`` otherwise.

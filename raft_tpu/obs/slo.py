@@ -35,6 +35,13 @@ incidents, dumping flight artifacts); budget state exports as
 ``raft_tpu_slo_burn_rate{slo=,window=}`` gauges; an exhausted budget
 turns ``SearchService.healthz()`` DEGRADED — serving keeps working,
 but the operator contract is broken and releases should freeze.
+
+``slo_burn`` edges are also *actuated*, not just paged on: the serve
+layer's :class:`~raft_tpu.serve.overload.AdmissionController`
+subscribes to them and raises its shed pressure floor while any burn
+alert for its index is live (the ``recovered=True`` edge releases the
+latch) — the closed loop from "budget is burning" to "lowest-priority
+traffic is shed" documented in ``docs/serving.md``.
 """
 
 from __future__ import annotations

@@ -31,6 +31,13 @@ indexes that change while being served.  Five pieces:
   partitioned across the mesh axis (brute-force rows / IVF lists), each
   shard running the existing local search with one cross-shard tie-stable
   ``select_k`` merge — capacity ≈ N× one chip instead of throughput ≈ N×.
+- :mod:`~raft_tpu.serve.overload` — overload-safe serving: priority
+  classes and deadlines on every request, an ``AdmissionController``
+  shedding lowest-priority-first under pressure (typed ``Shed`` /
+  ``DeadlineExceeded`` rejections, never silent), a
+  ``DegradedModeManager`` stepping search effort down with hysteresis,
+  and ``HedgedDispatcher`` racing replica members for p0 tail latency
+  (``SearchService(overload=True)`` / ``RAFT_TPU_OVERLOAD=1``).
 
 ``SearchService`` (:mod:`~raft_tpu.serve.service`) assembles them, and
 carries the obs v2 hooks: attach a :class:`raft_tpu.obs.QualityAuditor`
@@ -48,6 +55,14 @@ from raft_tpu.serve.metrics import (
     install_compile_listener,
 )
 from raft_tpu.serve.mutation import MutableIndex
+from raft_tpu.serve.overload import (
+    AdmissionController,
+    DeadlineExceeded,
+    DegradedModeManager,
+    HedgedDispatcher,
+    OverloadConfig,
+    Shed,
+)
 from raft_tpu.serve.ragged import FilterRegistry, RaggedSearcher, RaggedSpec
 from raft_tpu.serve.registry import IndexRegistry
 from raft_tpu.serve.replica import (
@@ -59,17 +74,23 @@ from raft_tpu.serve.service import SearchService
 from raft_tpu.serve.shard import ShardedIndex, shard_index
 
 __all__ = [
+    "AdmissionController",
     "CompactionPolicy",
     "Compactor",
+    "DeadlineExceeded",
+    "DegradedModeManager",
     "FilterRegistry",
+    "HedgedDispatcher",
     "IndexRegistry",
     "MicroBatcher",
     "MutableIndex",
+    "OverloadConfig",
     "RaggedSearcher",
     "RaggedSpec",
     "ReplicaGroup",
     "SearchService",
     "ServingMetrics",
+    "Shed",
     "ShardedIndex",
     "compile_count",
     "install_compile_listener",

@@ -67,7 +67,8 @@ import queue as queue_mod
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from contextlib import nullcontext
+from concurrent.futures import Future, TimeoutError as _FutureTimeout
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -79,6 +80,7 @@ from raft_tpu.kernels.toolkit import next_pow2
 from raft_tpu.obs import events as obs_events
 from raft_tpu.obs import flight, slowlog, spans
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
+from raft_tpu.serve.overload import expire_deadlines, validate_priority
 
 # search_fn: (queries [b, dim] float32) -> (distances [b, k], ids [b, k]).
 # In ragged mode the signature grows two descriptor columns:
@@ -98,16 +100,20 @@ _next_pow2 = next_pow2
 
 
 class _Request:
-    __slots__ = ("rows", "future", "t_submit", "req_id", "k", "fid")
+    __slots__ = ("rows", "future", "t_submit", "req_id", "k", "fid",
+                 "priority", "deadline")
 
     def __init__(self, rows: np.ndarray, future: Future, t_submit: float,
-                 req_id: int, k: int = 0, fid: int = 0):
+                 req_id: int, k: int = 0, fid: int = 0,
+                 priority: int = 1, deadline: Optional[float] = None):
         self.rows = rows
         self.future = future
         self.t_submit = t_submit
         self.req_id = req_id
         self.k = k        # ragged mode: this request's top-k (<= k_max)
         self.fid = fid    # ragged mode: registered filter id (0 = all-pass)
+        self.priority = priority    # 0 interactive … 3 background
+        self.deadline = deadline    # absolute perf_counter s, or None
 
 
 class _InFlight:
@@ -178,6 +184,19 @@ class MicroBatcher:
         ``[:k]`` after copy-out.  One executable per capacity bucket —
         the (bucket × k × filter) variant lattice collapses.  At
         ``pipeline_depth`` > 1 admission is continuous (see the worker).
+    admission / degraded / hedger:
+        Optional overload actuators (:mod:`raft_tpu.serve.overload`).
+        ``admission`` (an :class:`~raft_tpu.serve.overload.
+        AdmissionController`) runs at every batch cut — it expires
+        past-deadline requests and sheds low-priority work under
+        pressure, resolving their futures with typed errors before the
+        batch reaches the device; its verdict also feeds ``degraded``
+        (a :class:`~raft_tpu.serve.overload.DegradedModeManager`),
+        whose hysteretic effort level the search fn may consult.
+        Without a controller, deadline expiry still runs at every cut.
+        ``hedger`` (a :class:`~raft_tpu.serve.overload.
+        HedgedDispatcher`) reroutes batches carrying priority-0 traffic
+        through a raced two-member dispatch; warmup warms every member.
     """
 
     def __init__(
@@ -194,6 +213,9 @@ class MicroBatcher:
         cost_accounting: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
         ragged=None,
+        admission=None,
+        degraded=None,
+        hedger=None,
     ):
         if dim <= 0:
             raise ValueError(f"dim must be positive, got {dim}")
@@ -231,6 +253,16 @@ class MicroBatcher:
         self.ragged = ragged
         if ragged is not None and ragged.k_max < 1:
             raise ValueError(f"ragged k_max must be >= 1, got {ragged.k_max}")
+        # overload actuators (serve.overload); admission inherits this
+        # batcher's metrics so shed/expired requests land in the same
+        # error counters the SLO availability spec reads
+        self.admission = admission
+        self.degraded = degraded
+        self.hedger = hedger
+        if admission is not None and admission.metrics is None:
+            admission.metrics = self.metrics
+        if hedger is not None and hedger.metrics is None:
+            hedger.metrics = self.metrics
 
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
@@ -294,34 +326,45 @@ class MicroBatcher:
         zero-recompile contract is untouched.
         """
         total = 0
+        # degraded mode changes search params (host Python values the
+        # backends trace on), so every level of the ladder gets its own
+        # warmup pass — a pressure-driven level flip must never compile
+        # on the hot path
+        levels = (None,) if self.degraded is None else self.degraded.levels()
         with self._dispatch_lock, trace_range("serve.warmup"):
-            for b in self.buckets():
-                dummy = np.zeros((b, self.dim), dtype=np.float32)
-                c0 = compile_count(thread=True)
-                # ragged mode warms ONE variant per bucket — k and filter
-                # are data, so the dummy descriptor columns cover every
-                # later (k, fid) mix
-                dist, ids = self._invoke(dummy, [])
-                jax.block_until_ready((dist, ids))
-                total += compile_count(thread=True) - c0
-                if self.cost_accounting:
-                    self._account_bucket_cost(b, dummy)
+            for level in levels:
+                pin = (nullcontext() if level is None
+                       else self.degraded.pinned(level))
+                with pin:
+                    for b in self.buckets():
+                        dummy = np.zeros((b, self.dim), dtype=np.float32)
+                        c0 = compile_count(thread=True)
+                        # ragged mode warms ONE variant per bucket — k and
+                        # filter are data, so the dummy descriptor columns
+                        # cover every later (k, fid) mix
+                        dist, ids = self._invoke(dummy, [])
+                        jax.block_until_ready((dist, ids))
+                        if self.hedger is not None:
+                            self.hedger.warm(*self._invoke_args(dummy, []))
+                        total += compile_count(thread=True) - c0
+                        if self.cost_accounting and not level:
+                            self._account_bucket_cost(b, dummy)
         self.metrics.record_warmup(total)
         self.metrics.reset_hot_path()
         self._warm = True
         return total
 
-    def _invoke(self, padded: np.ndarray, batch: List[_Request]):
-        """Hand one padded bucket to the search fn.
+    def _invoke_args(self, padded: np.ndarray, batch: List[_Request]):
+        """The search fn's argument tuple for one padded bucket.
 
         Ragged mode attaches the per-request descriptor columns: each
         request's rows carry its ``(k, fid)``; padding rows run at
         ``k_max`` / filter 0 (all-pass), so the call is the same trace
         for every batch of this bucket.  Classic mode is the original
-        single-argument call, byte for byte.
+        single-argument form, byte for byte.
         """
         if self.ragged is None:
-            return self._search_fn(jax.numpy.asarray(padded))
+            return (jax.numpy.asarray(padded),)
         bucket = padded.shape[0]
         row_k = np.full((bucket,), self.ragged.k_max, np.int32)
         row_fid = np.zeros((bucket,), np.int32)
@@ -331,11 +374,21 @@ class MicroBatcher:
             row_k[off : off + m] = req.k
             row_fid[off : off + m] = req.fid
             off += m
-        return self._search_fn(
+        return (
             jax.numpy.asarray(padded),
             jax.numpy.asarray(row_k),
             jax.numpy.asarray(row_fid),
         )
+
+    def _invoke(self, padded: np.ndarray, batch: List[_Request]):
+        """Hand one padded bucket to the search fn (or, for batches
+        carrying priority-0 traffic with a hedger installed, to the
+        raced two-member dispatch)."""
+        args = self._invoke_args(padded, batch)
+        hedger = self.hedger
+        if hedger is not None and any(r.priority == 0 for r in batch):
+            return hedger.dispatch(*args)
+        return self._search_fn(*args)
 
     def _result_view(self, req: _Request, dist: np.ndarray, ids: np.ndarray,
                      off: int):
@@ -354,15 +407,7 @@ class MicroBatcher:
         try:
             from raft_tpu.obs import cost as obs_cost
 
-            if self.ragged is None:
-                args = (jax.numpy.asarray(dummy),)
-            else:
-                b = dummy.shape[0]
-                args = (
-                    jax.numpy.asarray(dummy),
-                    jax.numpy.full((b,), self.ragged.k_max, jax.numpy.int32),
-                    jax.numpy.zeros((b,), jax.numpy.int32),
-                )
+            args = self._invoke_args(dummy, [])
             report = obs_cost.analyze_callable(self._search_fn, *args)
             obs_cost.record_cost(
                 report,
@@ -433,7 +478,9 @@ class MicroBatcher:
 
     # -- submission ----------------------------------------------------------
     def submit(self, queries, *, k: Optional[int] = None,
-               fid: Optional[int] = None) -> Future:
+               fid: Optional[int] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one request of shape ``[dim]`` or ``[m, dim]``.
 
         Returns a future resolving to ``(distances [m, k], ids [m, k])``
@@ -447,6 +494,17 @@ class MicroBatcher:
         ceiling: the spec's ``k_max``) and ``fid`` a registered filter id
         (default 0, the all-pass row).  Heterogeneous ``(k, fid)`` mixes
         pack into one batch — they are descriptor data, not shapes.
+
+        Any mode: ``priority`` is the request's class (0=interactive,
+        1=standard — the default, 2=batch, 3=background) and
+        ``deadline_s`` a server-side budget measured from now.  Both are
+        host-side request metadata (no effect on executable shapes).  A
+        request whose deadline passes before its batch is cut resolves
+        with :class:`~raft_tpu.serve.overload.DeadlineExceeded` instead
+        of occupying a device slot; under overload an installed
+        :class:`~raft_tpu.serve.overload.AdmissionController` sheds the
+        lowest priorities first with the typed
+        :class:`~raft_tpu.serve.overload.Shed` error.
         """
         if self.ragged is None:
             if k is not None or fid is not None:
@@ -477,6 +535,13 @@ class MicroBatcher:
                 f"request of {rows.shape[0]} rows exceeds max_batch="
                 f"{self.max_batch}; split it client-side"
             )
+        priority = validate_priority(priority)
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            raise ValueError(
+                f"deadline_s must be positive, got {deadline_s}"
+            )
+        t_submit = time.perf_counter()
+        deadline = None if deadline_s is None else t_submit + float(deadline_s)
         req_id = flight.next_request_id()
         fut: Future = Future()
         fut.request_id = req_id
@@ -487,9 +552,11 @@ class MicroBatcher:
             inner.add_done_callback(
                 lambda f, out=fut: _squeeze_result(f, out)
             )
-            req = _Request(rows, inner, time.perf_counter(), req_id, k, fid)
+            req = _Request(rows, inner, t_submit, req_id, k, fid,
+                           priority, deadline)
         else:
-            req = _Request(rows, fut, time.perf_counter(), req_id, k, fid)
+            req = _Request(rows, fut, t_submit, req_id, k, fid,
+                           priority, deadline)
         with self._cond:
             if self._stopping and (
                 self._thread is None or not self._thread.is_alive()
@@ -501,12 +568,35 @@ class MicroBatcher:
         return fut
 
     def search(self, queries, timeout: Optional[float] = None, *,
-               k: Optional[int] = None, fid: Optional[int] = None):
-        """Synchronous convenience wrapper around :meth:`submit`."""
-        fut = self.submit(queries, k=k, fid=fid)
+               k: Optional[int] = None, fid: Optional[int] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None):
+        """Synchronous convenience wrapper around :meth:`submit`.
+
+        ``timeout`` doubles as the server-side deadline when
+        ``deadline_s`` is not given: a caller that stops waiting at
+        ``timeout`` must not leave its request occupying a batch slot
+        and running on device — the expired work is dropped (typed
+        :class:`~raft_tpu.serve.overload.DeadlineExceeded`) at the next
+        batch cut instead.
+        """
+        if deadline_s is None and timeout is not None:
+            deadline_s = timeout
+        fut = self.submit(queries, k=k, fid=fid, priority=priority,
+                          deadline_s=deadline_s)
         if self._thread is None or not self._thread.is_alive():
             self.flush()
-        return fut.result(timeout=timeout)
+        try:
+            return fut.result(timeout=timeout)
+        except _FutureTimeout:
+            # py3.10's futures.TimeoutError is not the builtin; normalize
+            # so callers catch one type whether the client-side wait or
+            # the server-side deadline expiry (DeadlineExceeded, also a
+            # TimeoutError) fired first
+            raise TimeoutError(
+                f"no result within {timeout}s (request still queued or "
+                "in flight; its deadline will expire it at the next cut)"
+            ) from None
 
     # -- batching core -------------------------------------------------------
     def flush(self) -> int:
@@ -526,6 +616,9 @@ class MicroBatcher:
                 if not self._queue:
                     break
                 batch = self._take_batch_locked()
+            batch = self._admit(batch)
+            if not batch:
+                continue
             if self.pipeline_depth == 1:
                 self._dispatch(batch)
             else:
@@ -571,6 +664,26 @@ class MicroBatcher:
             return []
         return self._take_batch_locked()
 
+    def _admit(self, batch: List[_Request]) -> List[_Request]:
+        """Batch-cut admission: expire deadlines and, with a controller
+        installed, shed under pressure.  Runs at every cut site, OUTSIDE
+        the queue condition — resolving a rejected future runs its done
+        callbacks inline.  Returns the requests that may dispatch."""
+        if not batch:
+            return batch
+        ctrl = self.admission
+        if ctrl is None:
+            return expire_deadlines(
+                batch, index=self.metrics.name or "default",
+                metrics=self.metrics,
+            )
+        decision = ctrl.decide(
+            batch, queue_rows=self.queue_depth(), max_batch=self.max_batch,
+        )
+        if self.degraded is not None:
+            self.degraded.step(decision.level > 0)
+        return list(decision.admitted)
+
     def _worker(self) -> None:
         # continuous admission (ragged + pipeline): claim the in-flight
         # window slot BEFORE cutting the batch.  While a full window
@@ -595,15 +708,20 @@ class MicroBatcher:
                 self._inflight_sem.acquire()
                 with self._cond:
                     batch = self._coalesce_locked()
+                batch = self._admit(batch)
                 if not batch:
                     self._inflight_sem.release()
                     continue
                 self._dispatch_pipelined(batch, sem_held=True)
-            elif self.pipeline_depth > 1:
-                self._dispatch_pipelined(batch)
             else:
-                with self._dispatch_lock:
-                    self._dispatch_locked(batch)
+                batch = self._admit(batch)
+                if not batch:
+                    continue
+                if self.pipeline_depth > 1:
+                    self._dispatch_pipelined(batch)
+                else:
+                    with self._dispatch_lock:
+                        self._dispatch_locked(batch)
 
     def _dispatch(self, batch: List[_Request]) -> None:
         with self._dispatch_lock:

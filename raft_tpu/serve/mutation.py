@@ -363,15 +363,18 @@ class MutableIndex:
         with self._lock:
             return self._snapshot_cache
 
-    def _main_search(self, queries, k, tombstones, sample_filter=None):
+    def _main_search(self, queries, k, tombstones, sample_filter=None,
+                     search_params=None):
         mod = _kind_module(self.kind)
         if self.kind == "brute_force":
             return mod.search(
                 self.index, queries, k,
                 deleted_mask=tombstones, sample_filter=sample_filter,
             )
+        params = self.search_params if search_params is None \
+            else search_params
         return mod.search(
-            self.search_params, self.index, queries, k,
+            params, self.index, queries, k,
             deleted_mask=tombstones, sample_filter=sample_filter,
         )
 
@@ -400,8 +403,37 @@ class MutableIndex:
         bit = (sample_filter.words[word_ix] >> bit_ix) & jnp.uint32(1)
         return Bitset.from_mask(jnp.where(in_range, bit == 1, True) & live)
 
+    def _main_filter_rows(self, snap: _Snapshot, sample_filter):
+        """Row-space view of ``sample_filter`` for a compacted main index.
+
+        After compaction the backend's stored rows are dense (promotion
+        renumbered them) while the caller's filter stays keyed by
+        *global* ids — the ids results are remapped to and the ids the
+        :class:`~raft_tpu.serve.ragged.FilterRegistry` was built over.
+        Gather each stored row's bit through the compaction id map
+        (``snap.main_ids``), exactly like :meth:`_side_passes` does
+        through ``side_ids``.  Uncovered ids pass (a filter constrains
+        only ids it covers); padding sentinels (gid −1) also pass here
+        but never surface — promotion registered them as structural
+        tombstones, which compose via ``deleted_mask``.
+        """
+        gids = snap.main_ids                  # [rows] int32, -1 = padding
+        g = jnp.clip(gids, 0)
+        covered = (gids >= 0) & (gids < jnp.int32(sample_filter.n_bits))
+        word_ix = jnp.clip(g // 32, 0, sample_filter.words.shape[-1] - 1)
+        bit_ix = (g % 32).astype(jnp.uint32)
+        if isinstance(sample_filter, RowFilter):
+            bit = (
+                sample_filter.words[:, word_ix] >> bit_ix[None, :]
+            ) & jnp.uint32(1)
+            mask = jnp.where(covered[None, :], bit == 1, True)
+            return RowFilter.from_mask_rows(mask)
+        bit = (sample_filter.words[word_ix] >> bit_ix) & jnp.uint32(1)
+        return Bitset.from_mask(jnp.where(covered, bit == 1, True))
+
     def search(self, queries, k: int, *, sample_filter=None,
-               row_k=None) -> Tuple[jax.Array, jax.Array]:
+               row_k=None, search_params=None
+               ) -> Tuple[jax.Array, jax.Array]:
         """Merged top-k over main (tombstone-filtered) + side buffer.
 
         Returns (distances [q, k], ids [q, k]); pruned/padding slots are
@@ -411,10 +443,15 @@ class MutableIndex:
         :class:`~raft_tpu.core.bitset.RowFilter` with one pass-row per
         query — the ragged path's form) restricts results by global id;
         it composes with tombstones inside the main search and is remapped
-        to slot space for the side scan.  ``row_k`` (``[q] int32``) caps
-        each row's results below ``k`` as *data* — positions past a row's
-        own k surface as id −1 at the worst distance, with no new
-        executable per distinct k.
+        to slot space for the side scan (and, on a compacted index, to
+        dense row space for the main search — filters survive
+        compaction).  ``row_k`` (``[q] int32``) caps each row's results
+        below ``k`` as *data* — positions past a row's own k surface as
+        id −1 at the worst distance, with no new executable per distinct
+        k.  ``search_params`` overrides the index's own params for this
+        call (the degraded-mode ladder's hook); every distinct params
+        value is a distinct jit variant, so overriders must warm what
+        they pass.
         """
         queries = jnp.asarray(queries, jnp.float32)
         if queries.ndim != 2 or queries.shape[1] != self.dim:
@@ -422,18 +459,17 @@ class MutableIndex:
                 f"queries shape {queries.shape} vs index dim {self.dim}"
             )
         snap = self._snapshot()
+        main_filter = sample_filter
         if sample_filter is not None and snap.main_ids is not None:
-            raise NotImplementedError(
-                "sample_filter over a compacted index: filters are keyed "
-                "by global ids but the backend filters its dense stored "
-                "rows — remapping would need a [q, main_rows] intermediate "
-                "per batch.  Serve ragged filters and compaction on "
-                "different indexes for now."
-            )
+            # compacted index: remap the global-id filter through the
+            # compaction id map so the dense-row backend tests the right
+            # bits.  Costs one [q, main_rows] mask per batch — shaped by
+            # the bucket and the fixed id map only, so nothing recompiles.
+            main_filter = self._main_filter_rows(snap, sample_filter)
         select_min = DISTANCE_TYPES[self.metric] != "inner_product"
         with trace_range("serve.mutable_search"):
             dist, ids = self._main_search(
-                queries, k, snap.tombstones, sample_filter
+                queries, k, snap.tombstones, main_filter, search_params
             )
             if snap.main_ids is not None:
                 # compacted index: the backend returned dense row ids;

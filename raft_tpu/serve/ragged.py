@@ -189,11 +189,14 @@ class RaggedSearcher:
     """
 
     def __init__(self, service, name: str, spec: RaggedSpec,
-                 filters: Optional[FilterRegistry]):
+                 filters: Optional[FilterRegistry], degraded=None):
         self._service = service
         self._name = name
         self._spec = spec
         self._filters = filters
+        # optional serve.overload.DegradedModeManager: under sustained
+        # pressure its level prescribes reduced-effort search params
+        self._degraded = degraded
 
     @property
     def filters(self) -> Optional[FilterRegistry]:
@@ -229,7 +232,13 @@ class RaggedSearcher:
             dist, ids = index.search(queries, self._spec.k_max)
             select_min = DISTANCE_TYPES[index.metric] != "inner_product"
             return mask_row_k(dist, ids, row_k, select_min=select_min)
+        search_params = None
+        if self._degraded is not None:
+            # reduced-effort params under pressure; every (bucket, level)
+            # variant was warmed by the batcher's level-pinned warmup
+            search_params = self._degraded.params_for(index)
         return index.search(
             queries, self._spec.k_max,
             sample_filter=sample_filter, row_k=row_k,
+            search_params=search_params,
         )

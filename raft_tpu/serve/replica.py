@@ -234,3 +234,22 @@ class ReplicaGroup:
             return self.search(name, queries, k)
 
         return search_fn
+
+    def member_searchers(self, name: str, k: int):
+        """Two independently-dispatched searchers for hedged dispatch
+        (:class:`~raft_tpu.serve.overload.HedgedDispatcher`): the
+        replicated mesh search as the primary, and a direct single-chip
+        search resolved against the same registry as the hedge.  The two
+        run genuinely different executables — if the collective path
+        stalls (a straggling replica, a slow all-gather), the local
+        member still answers from one chip.  On a multi-host deployment
+        the hedge member would instead target a second replica group on
+        another slice; the host-side contract (same signature, distinct
+        dispatch) is identical.
+        """
+
+        def local_fn(queries):
+            index, _version = self.registry.get_versioned(name)
+            return index.search(queries, k)
+
+        return (self.searcher(name, k), local_fn)

@@ -45,6 +45,12 @@ from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
 from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
+from raft_tpu.serve.overload import (
+    AdmissionController,
+    DegradedModeManager,
+    HedgedDispatcher,
+    OverloadConfig,
+)
 from raft_tpu.serve.ragged import FilterRegistry, RaggedSearcher, RaggedSpec
 from raft_tpu.serve.registry import IndexRegistry
 from raft_tpu.serve.replica import ReplicaGroup
@@ -72,6 +78,7 @@ class SearchService:
             None, bool, Sequence[obs_slo.SloSpec], obs_slo.SloEngine
         ] = None,
         ragged: Union[None, bool, RaggedSpec] = None,
+        overload: Union[None, bool, OverloadConfig] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -107,6 +114,24 @@ class SearchService:
                 "the replica path has no descriptor-column leg yet"
             )
         self._filter_regs: Dict[str, Optional[FilterRegistry]] = {}
+        # overload=None: RAFT_TPU_OVERLOAD decides.  True: config from
+        # env.  An OverloadConfig is used as-is.  When set, every added
+        # index gets an AdmissionController (priority shedding + deadline
+        # expiry at batch cut, driven by queue pressure and slo_burn
+        # events) and a DegradedModeManager (hysteretic search-effort
+        # ladder; local dispatch only — the replica path has no params
+        # leg).  Hedged priority-0 dispatch additionally needs replicas
+        # and config.hedge.  Deadline-only expiry runs even without this.
+        if overload is None:
+            overload = _env.env_bool("RAFT_TPU_OVERLOAD", False)
+        if overload is True:
+            overload = OverloadConfig.from_env()
+        elif overload is False:
+            overload = None
+        self.overload: Optional[OverloadConfig] = overload
+        self._admission: Dict[str, AdmissionController] = {}
+        self._degraded: Dict[str, DegradedModeManager] = {}
+        self._hedgers: Dict[str, HedgedDispatcher] = {}
         self._start = start
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -170,9 +195,30 @@ class SearchService:
                 f"{self.ragged.k_max}"
             )
         version = self.registry.register(name, index)
+        admission = degraded = hedger = None
+        if self.overload is not None:
+            admission = AdmissionController(self.overload, name=name)
+            if self.replicas is None:
+                # degraded-mode search threads reduced-effort params into
+                # the local dispatch; the replica path has no params leg
+                degraded = DegradedModeManager(self.overload, name=name)
+            if self.overload.hedge and self.replicas is not None:
+                hedger = HedgedDispatcher(
+                    self.replicas.member_searchers(name, k),
+                    self.overload, name=name,
+                )
         with self._lock:
             self._ks[name] = k
             old = self._batchers.pop(name, None)
+            old_admission = self._admission.pop(name, None)
+            self._degraded.pop(name, None)
+            self._hedgers.pop(name, None)
+            if admission is not None:
+                self._admission[name] = admission
+            if degraded is not None:
+                self._degraded[name] = degraded
+            if hedger is not None:
+                self._hedgers[name] = hedger
             if self.ragged is not None:
                 freg = None
                 if self.ragged.filters and isinstance(index, MutableIndex):
@@ -181,7 +227,9 @@ class SearchService:
                     # every filter (uncovered = unconstrained).
                     freg = FilterRegistry(max(1, index.main_size))
                 self._filter_regs[name] = freg
-                search_fn = RaggedSearcher(self, name, self.ragged, freg)
+                search_fn = RaggedSearcher(
+                    self, name, self.ragged, freg, degraded=degraded
+                )
             else:
                 search_fn = self._make_search_fn(name, k)
             batcher = MicroBatcher(
@@ -196,10 +244,15 @@ class SearchService:
                 cost_accounting=self.cost_accounting,
                 pipeline_depth=self.pipeline_depth,
                 ragged=self.ragged,
+                admission=admission,
+                degraded=degraded,
+                hedger=hedger,
             )
             self._batchers[name] = batcher
         if old is not None:
             old.stop()
+        if old_admission is not None:
+            old_admission.close()
         if self.slo_engine is not None and self._slo_auto and old is None:
             self.slo_engine.watch_index(name)
         if warmup:
@@ -213,6 +266,13 @@ class SearchService:
             index, _version = self.registry.get_versioned(name)
             if self.replicas is not None:
                 return self.replicas.search(name, queries, k)
+            mgr = self._degraded.get(name)
+            if mgr is not None and isinstance(index, MutableIndex):
+                params = mgr.params_for(index)
+                if params is not None:
+                    # reduced-effort params under pressure; warmed per
+                    # level by the batcher's level-pinned warmup
+                    return index.search(queries, k, search_params=params)
             return index.search(queries, k)
 
         return search_fn
@@ -299,7 +359,12 @@ class SearchService:
             batcher = self._batchers.pop(name)
             self._ks.pop(name, None)
             self._filter_regs.pop(name, None)
+            admission = self._admission.pop(name, None)
+            self._degraded.pop(name, None)
+            self._hedgers.pop(name, None)
         batcher.stop()
+        if admission is not None:
+            admission.close()
         self.registry.unregister(name)
         if self.slo_engine is not None and self._slo_auto:
             self.slo_engine.unwatch_index(name)
@@ -334,24 +399,44 @@ class SearchService:
         return k, fid
 
     def submit(self, name: str, queries, *, k: Optional[int] = None,
-               fid: Optional[int] = None):
+               fid: Optional[int] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None):
         """Async search; returns a Future of (distances, ids).
 
         Ragged mode only: ``k`` (defaults to the index's configured k,
         ceiling ``spec.k_max``) and ``fid`` (a :meth:`register_filter`
         handle; 0/None = unfiltered) shape THIS request inside the packed
         batch — heterogeneous mixes coalesce into one dispatch.
+
+        Any mode: ``priority`` (0=interactive … 3=background, default 1)
+        and ``deadline_s`` (server-side budget from now) ride as request
+        metadata — under overload the admission controller sheds the
+        lowest priorities first and expired requests never reach the
+        device; their futures resolve with the typed
+        :class:`~raft_tpu.serve.overload.Shed` /
+        :class:`~raft_tpu.serve.overload.DeadlineExceeded` errors.
         """
         k, fid = self._ragged_args(name, k, fid)
-        return self._batcher(name).submit(queries, k=k, fid=fid)
+        return self._batcher(name).submit(
+            queries, k=k, fid=fid, priority=priority, deadline_s=deadline_s
+        )
 
     @traced("serve.search")
     def search(self, name: str, queries, timeout: Optional[float] = None,
-               *, k: Optional[int] = None, fid: Optional[int] = None):
-        """Sync search through the batcher (coalesces with live traffic)."""
+               *, k: Optional[int] = None, fid: Optional[int] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None):
+        """Sync search through the batcher (coalesces with live traffic).
+
+        ``timeout`` doubles as the server-side deadline when
+        ``deadline_s`` is not given — a request its caller has stopped
+        waiting for is dropped at the next batch cut instead of running
+        on device."""
         k, fid = self._ragged_args(name, k, fid)
         return self._batcher(name).search(
-            queries, timeout=timeout, k=k, fid=fid
+            queries, timeout=timeout, k=k, fid=fid,
+            priority=priority, deadline_s=deadline_s,
         )
 
     @traced("serve.warmup")
@@ -418,6 +503,22 @@ class SearchService:
             pending_deletes=deleted,
             side_rows=side,
         )
+        ctrl = self._admission.get(name)
+        if ctrl is not None:
+            out.update(
+                admission_level=ctrl.last_level,
+                shed_requests=ctrl.shed_total,
+                deadline_expired=ctrl.expired_total,
+            )
+        mgr = self._degraded.get(name)
+        if mgr is not None:
+            out["degraded_level"] = mgr.level
+        hedger = self._hedgers.get(name)
+        if hedger is not None:
+            out.update(
+                hedges_fired=hedger.fired_total,
+                hedge_wins=hedger.hedge_wins,
+            )
         return out
 
     def _refresh_capacity_gauges(self) -> None:
@@ -496,6 +597,8 @@ class SearchService:
                 except Exception:
                     compaction = {}
             last_abort = compaction.get("last_abort")
+            ctrl = self._admission.get(name)
+            mgr = self._degraded.get(name)
             probes[name] = obs_health.IndexProbe(
                 warm=b.warm,
                 recompiles=b.metrics.recompiles,
@@ -503,6 +606,10 @@ class SearchService:
                 max_batch=b.max_batch,
                 pipeline_depth=b.pipeline_depth,
                 inflight=b.inflight,
+                admission_level=(
+                    ctrl.last_level if ctrl is not None else None
+                ),
+                degraded_level=mgr.level if mgr is not None else None,
                 recall_ewma=(
                     auditor.recall_ewma(name) if auditor is not None else None
                 ),
@@ -597,8 +704,13 @@ class SearchService:
             self.compactor.stop()
         with self._lock:
             batchers = list(self._batchers.values())
+            controllers = list(self._admission.values())
         for b in batchers:
             b.stop()
+        # after the batchers: a draining batch may still cut through the
+        # admission path, which wants its burn latch live
+        for ctrl in controllers:
+            ctrl.close()
 
     def __enter__(self) -> "SearchService":
         return self

@@ -466,6 +466,71 @@ def test_ragged_smoke_against_frozen_record(tmp_path):
 
 
 @pytest.mark.slow
+def test_overload_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the overload-control A/B: run ``bench.py overload``
+    (admission control + degraded-mode ladder vs the same batcher with
+    neither, both under the same open-loop Poisson stream at 2x measured
+    capacity) and gate it with ``bench.py compare`` against the frozen
+    record.  The leg itself asserts the non-negotiables (priority 0 never
+    shed, zero errors, zero post-warmup recompiles on both arms, every
+    shed on the bus and inside a correlated incident, uncontrolled-arm
+    queue collapse); here we re-check the headline numbers from the
+    emitted line.  Steady-state is the post-onset window (scheduled
+    arrival >= 1.5 s): the 0->2x step has an honest transient while the
+    effort ladder's hysteresis engages, so the full-stream ratio is
+    looser and the p1 shed bound is a small fraction, not zero — a stray
+    container hiccup can brush the top pressure level for one cut."""
+    candidate = str(tmp_path / "overload_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "overload"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "overload leg recompiled on the hot path"
+    on = line["arms"]["controlled"]
+    off = line["arms"]["uncontrolled"]
+    assert "0" not in on["shed_by_priority"], "interactive traffic was shed"
+    assert on["errors"] == 0 and off["errors"] == 0
+    # controlled arm holds the interactive tail and keeps goodput
+    assert line["p0_steady_p99_vs_uncontended"] <= 2.0, (
+        "controller failed to hold steady-state p0 p99: "
+        f"{line['p0_steady_p99_vs_uncontended']}x uncontended"
+    )
+    assert on["goodput_vs_capacity"] >= 0.9, (
+        f"controlled-arm goodput collapsed: {on['goodput_vs_capacity']}"
+    )
+    steady = on["steady_shed_by_priority"]
+    assert "0" not in steady
+    total_steady = sum(steady.values())
+    assert total_steady > 0, "2x overload produced no steady-state shedding"
+    assert steady.get("1", 0) <= 0.05 * total_steady, (
+        f"steady-state shedding was not lowest-priority-first: {steady}"
+    )
+    # uncontrolled arm collapses: unbounded queue, p0 tail gone
+    assert off["queue_rows_at_submit_end"] > 4 * max(
+        1, on["queue_rows_at_submit_end"]
+    )
+    assert line["off_p0_p99_vs_on"] > 4.0
+    # observability: decisions visible on the bus and in an incident
+    assert line["shed_event_on_bus"] and line["degraded_event_on_bus"]
+    assert line["shed_in_incident"]
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_overload_r12.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
 def test_slo_engine_overhead_smoke_against_frozen_record(tmp_path):
     """CI smoke for the SLO-engine A/B: run ``bench.py slo`` (pooled
     interleaved rounds, background evaluator on a 200 ms tick vs no

@@ -230,6 +230,82 @@ def test_warmup_variant_count_is_per_bucket_only():
 
 
 # ---------------------------------------------------------------------------
+# filters survive compaction: after the compactor permutes the main
+# structure, per-request fids must keep constraining by *global* id
+# (this path used to raise NotImplementedError)
+
+
+def test_filters_survive_compaction(corpus):
+    from raft_tpu.core.bitset import Bitset as _Bitset
+    from raft_tpu.serve.compactor import CompactionPolicy, Compactor
+
+    x, q = corpus
+    svc = _ragged_service(_build("ivf_flat", x), depth=1)
+    try:
+        even, band = _masks(len(x))
+        fids = (0, svc.register_filter("t", even),
+                svc.register_filter("t", band))
+        svc.warmup("t")
+
+        mi = svc.get("t")
+        rng = np.random.default_rng(7)
+        dead = np.sort(rng.choice(len(x), size=40, replace=False))
+        mi.delete(dead)
+        new_rows = rng.random((24, x.shape[1]), dtype=np.float32)
+        new_ids = np.asarray(mi.upsert(new_rows))
+
+        res = Compactor(
+            svc,
+            CompactionPolicy(chunk_rows=128, gate_queries=16,
+                             max_side_rows=16),
+            start=False,
+        ).trigger_now("t")
+        assert res["status"] == "promoted", res
+        served = svc.get("t")
+        assert served is not mi
+        # the compacted main structure is a *permutation* of global ids —
+        # the exact situation the row-space filter remap exists for
+        assert served._main_ids is not None
+
+        keep = np.setdiff1d(np.arange(len(x)), dead)
+        for slot, mask in ((1, even), (2, band)):
+            # ids the filter allows post-compaction: covered survivors
+            # whose bit is set, plus side-born ids past the registry's
+            # id space (uncovered ids are unconstrained by contract)
+            allowed = np.concatenate([keep[mask[keep]], new_ids])
+            allowed_rows = np.concatenate(
+                [x[keep[mask[keep]]], new_rows])
+            gt_local = np.asarray(
+                brute_force.knn(allowed_rows, q[:4], 5)[1])
+            gt = allowed[gt_local]
+
+            futs = [svc.submit("t", q[i], k=5, fid=fids[slot])
+                    for i in range(4)]
+            svc.flush("t")
+            for i, fut in enumerate(futs):
+                _d, ids = fut.result(timeout=60)
+                got = [g for g in np.asarray(ids).tolist() if g >= 0]
+                assert set(got) <= set(allowed.tolist()), (
+                    "filter leaked a denied (or deleted) id after "
+                    "compaction"
+                )
+                # n_probes == n_lists: the scan is exhaustive, so the
+                # filtered result must match brute force over the
+                # allowed rows exactly (as a set; ties may reorder)
+                assert set(got) == set(gt[i].tolist())
+
+        # the Bitset (uniform-filter) leg of the remap, straight through
+        # MutableIndex.search
+        bs = _Bitset.from_mask(jnp.asarray(even))
+        _d, ids = served.search(jnp.asarray(q[:2]), 5, sample_filter=bs)
+        flat = [g for g in np.asarray(ids).reshape(-1).tolist() if g >= 0]
+        ok = set(keep[even[keep]].tolist()) | set(new_ids.tolist())
+        assert set(flat) <= ok
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
 # argument validation at the service boundary
 
 
