@@ -64,6 +64,8 @@ SERVE_ENTRY_POINTS = {
         "serve.admission.decide",
     ("serve.overload.DegradedModeManager", "step"): "serve.degrade.step",
     ("serve.overload.HedgedDispatcher", "dispatch"): "serve.hedge.dispatch",
+    ("obs.perf.PerfLedger", "record"): "perf.record",
+    ("obs.perf.PerfLedger", "evaluate"): "perf.evaluate",
 }
 
 

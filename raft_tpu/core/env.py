@@ -170,6 +170,26 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
            "mutation"),
     EnvVar("RAFT_TPU_DISABLE_PROFILER", "bool", "unset",
            "1 disables the Perfetto capture helper"),
+    EnvVar("RAFT_TPU_PERF_LEDGER", "bool", "1",
+           "0 disables the measured perf ledger (per-executable "
+           "device-time attribution + regression detection)"),
+    EnvVar("RAFT_TPU_PERF_EWMA_ALPHA", "float", "0.25",
+           "fast-EWMA weight of the per-bucket device-time regression "
+           "detector (the slow baseline uses alpha/8)"),
+    EnvVar("RAFT_TPU_PERF_REGRESSION_X", "float", "1.5",
+           "regression trip ratio: fast device-time EWMA over this "
+           "multiple of the slow baseline publishes perf_regression"),
+    EnvVar("RAFT_TPU_PERF_MIN_SAMPLES", "int", "32",
+           "dispatches per executable key before the regression "
+           "detector arms (warm baselines only)"),
+    EnvVar("RAFT_TPU_PERF_DEBOUNCE_S", "float", "60",
+           "minimum seconds between perf_regression events (and profile "
+           "captures) per executable key"),
+    EnvVar("RAFT_TPU_PERF_CAPTURE_S", "float", "1.0",
+           "duration of the auto profile capture a perf_regression "
+           "triggers (0 disables the capture, the event still fires)"),
+    EnvVar("RAFT_TPU_PERF_CAPTURE_DIR", "str", "flight dir",
+           "where regression-triggered profiler captures are written"),
     EnvVar("RAFT_TPU_PEAK_FLOPS", "float", "per-platform",
            "roofline FLOP/s peak for obs.cost utilization estimates"),
     EnvVar("RAFT_TPU_PEAK_BW", "float", "per-platform",

@@ -17,6 +17,8 @@ Dispatch: ``use_pallas()`` consults RAFT_TPU_PALLAS:
 
 from __future__ import annotations
 
+import threading
+
 import jax
 
 from raft_tpu.core import env as _env
@@ -35,6 +37,36 @@ def use_pallas() -> bool:
     return _platform() == "tpu"
 
 
+# ---------------------------------------------------------------------------
+# live kernel-path attribution
+#
+# The routing decisions above (and their per-leg twins inside
+# neighbors/ivf_flat.py, neighbors/ivf_pq.py) happen in host Python on
+# every search call, but the *outcome* — which leg actually ran — was
+# visible only in frozen bench records.  The serve layer wants it per
+# dispatch, so each routing branch stamps the leg it took into a
+# thread-local and the batcher consumes the stamp right after the search
+# callable returns (same thread, zero locks, zero clock calls).  Values
+# are a tiny closed vocabulary: "pallas", "xla", "xla_filter_fallback"
+# (the per-row-filter XLA leg), "sharded" (SPMD shard_map dispatch, where
+# per-leg stamps would fire at trace time only).
+
+_kernel_path_tls = threading.local()
+
+
+def stamp_kernel_path(path: str) -> None:
+    """Record which kernel leg the current search call routed to."""
+    _kernel_path_tls.value = path
+
+
+def consume_kernel_path(default: str = "unknown") -> str:
+    """Pop the stamp left by the last search on this thread (or
+    ``default`` when the search ran elsewhere, e.g. on hedge threads)."""
+    path = getattr(_kernel_path_tls, "value", None)
+    _kernel_path_tls.value = None
+    return path if path is not None else default
+
+
 def interpret_mode() -> bool:
     """Pallas interpret=True off-TPU so kernels are testable on CPU
     (SURVEY §5: sanitizer analog — interpret mode is also the OOB guard)."""
@@ -48,6 +80,8 @@ from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major  # noqa: E402
 __all__ = [
     "use_pallas",
     "interpret_mode",
+    "stamp_kernel_path",
+    "consume_kernel_path",
     "fused_l2_topk",
     "fused_l2_argmin",
     "ivf_scan_probe_major",

@@ -165,6 +165,12 @@ def knn(
     select_min = canonical != "inner_product"
     n, d = dataset.shape
 
+    # perf-ledger attribution: brute force has no Pallas leg — every
+    # dispatch is the tiled XLA matmul path
+    from raft_tpu.kernels import stamp_kernel_path
+
+    stamp_kernel_path("xla")
+
     from raft_tpu.neighbors._common import resolve_pass_filter
 
     pass_filter = resolve_pass_filter(sample_filter, deleted_mask)

@@ -56,6 +56,7 @@ from raft_tpu.neighbors._common import (
     select_scan_strategy,
     unpack_lists,
 )
+from raft_tpu.kernels import stamp_kernel_path as _stamp_kernel_path
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
 from raft_tpu.core.logger import logger as _log
@@ -671,6 +672,7 @@ def search(
                 None if fw is None
                 else pack_list_filter(index.list_index, fw)
             )
+            _stamp_kernel_path("pallas")
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
@@ -679,6 +681,8 @@ def search(
                     bucket, interpret_mode(),
                 )
         else:
+            _stamp_kernel_path("xla")
+
             def run_pm(qt):
                 return _search_probe_major_jit(
                     qt,
@@ -715,6 +719,7 @@ def search(
                 index.list_index, sample_filter.table
             )
             fid = jnp.asarray(sample_filter.fid, jnp.int32)
+            _stamp_kernel_path("pallas")
 
             def run_qm(qt, ft):
                 return _search_query_major_pallas(
@@ -732,6 +737,7 @@ def search(
             None if fw is None
             else _scan_mod.pack_list_filter(index.list_index, fw)
         )
+        _stamp_kernel_path("pallas")
 
         def run_qm(qt):
             return _search_query_major_pallas(
@@ -746,6 +752,9 @@ def search(
     # tile queries so the [t, p, cap, d] gather respects the workspace budget
     per_q = 4 * n_probes * index.list_cap * (index.dim + 2)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=256))))
+    # per-row filters land here only when the fused descriptor leg was
+    # unavailable — stamp the fallback distinctly for the perf ledger A/B
+    _stamp_kernel_path("xla_filter_fallback" if per_row else "xla")
     return _search_jit(
         queries,
         index.centers,

@@ -74,6 +74,7 @@ from raft_tpu.neighbors._common import (
     select_scan_strategy,
     unpack_lists,
 )
+from raft_tpu.kernels import stamp_kernel_path as _stamp_kernel_path
 from raft_tpu.kernels.toolkit import int8_scored_ip, quantize_queries_i8
 from raft_tpu.ops.matrix import select_k
 from raft_tpu.core.trace import traced
@@ -1362,6 +1363,7 @@ def search(
                 None if fw is None
                 else pack_list_filter(index.list_index, fw)
             )
+            _stamp_kernel_path("pallas")
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
@@ -1371,6 +1373,8 @@ def search(
                     canonical, bucket, params.lut_dtype, interpret_mode(),
                 )
         else:
+            _stamp_kernel_path("xla")
+
             def run_pm(qt):
                 return _search_probe_major_jit(
                     qt,
@@ -1413,6 +1417,7 @@ def search(
             None if fw is None
             else _scan_mod.pack_list_filter(index.list_index, fw)
         )
+        _stamp_kernel_path("pallas")
 
         def run_qm(qt):
             return _search_query_major_pallas(
@@ -1432,6 +1437,10 @@ def search(
         itemsize = 2 if scan_dtype == jnp.bfloat16 else 4
     per_q = n_probes * index.list_cap * (index.rot_dim * itemsize + 12)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=1024))))
+    # per-row filters land here because the fused wrapper has no
+    # descriptor plumbing — stamp the leg distinctly so the perf ledger's
+    # A/B shows how much traffic rides the fallback
+    _stamp_kernel_path("xla_filter_fallback" if per_row else "xla")
     return _search_jit(
         queries,
         index.centers,

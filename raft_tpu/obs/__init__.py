@@ -43,6 +43,11 @@ into queryable state:
 - :mod:`~raft_tpu.obs.incidents` — bus subscriber correlating bursts of
   events into incident timelines with service context at open/close,
   exported as JSON + Chrome trace alongside flight dumps.
+- :mod:`~raft_tpu.obs.perf` — measured perf ledger: per-executable
+  device-time attribution keyed ``(index, backend, bucket, kernel_path,
+  version)``, hotspot ranking with measured roofline utilization, and a
+  per-bucket EWMA regression detector that auto-triggers a profiler
+  capture and lands inside the correlated incident.
 
 Quick start::
 
@@ -89,7 +94,12 @@ from raft_tpu.obs.incidents import (
     IncidentManager,
     incidents_snapshot,
 )
-from raft_tpu.obs.profiler import profile
+from raft_tpu.obs.perf import (
+    PerfLedger,
+    default_ledger,
+    ledger_snapshot,
+)
+from raft_tpu.obs.profiler import capture_async, last_capture, profile
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.obs.registry import (
     Counter,
@@ -117,6 +127,8 @@ from raft_tpu.obs import (
     flight,
     health,
     incidents,
+    perf,
+    profiler,
     quality,
     slo,
     slowlog,
@@ -137,6 +149,7 @@ def install() -> None:
     reg.register_provider("spans", spans_snapshot)
     reg.register_provider("slow_queries", slowlog_snapshot)
     reg.register_provider("flight", flight_snapshot)
+    reg.register_provider("perf", ledger_snapshot)
     events.default_bus()
 
 
@@ -159,15 +172,18 @@ __all__ = [
     "IncidentManager",
     "LabelCardinalityError",
     "MetricsRegistry",
+    "PerfLedger",
     "QualityAuditor",
     "SloEngine",
     "SloSpec",
     "Span",
     "analyze_callable",
     "analyze_compiled",
+    "capture_async",
     "cost",
     "current_span",
     "default_bus",
+    "default_ledger",
     "default_recorder",
     "default_registry",
     "events",
@@ -178,9 +194,13 @@ __all__ = [
     "incidents",
     "incidents_snapshot",
     "install",
+    "last_capture",
+    "ledger_snapshot",
     "next_request_id",
     "open_span",
+    "perf",
     "profile",
+    "profiler",
     "publish",
     "quality",
     "recent_spans",

@@ -69,6 +69,7 @@ KINDS = frozenset({
     "degraded_enter",
     "degraded_exit",
     "hedge_fired",
+    "perf_regression",
 })
 
 #: kinds that open incidents / trigger flight dumps; the rest are context
@@ -81,6 +82,7 @@ TRIGGER_KINDS = frozenset({
     "slo_burn",
     "admission_shed",
     "degraded_enter",
+    "perf_regression",
 })
 
 #: default recent-events ring capacity
@@ -300,8 +302,14 @@ def _install_default_subscribers(bus: EventBus) -> None:
     # keeps the obs package cycle-free.
     from raft_tpu.obs import flight as _flight
     from raft_tpu.obs import incidents as _incidents
+    from raft_tpu.obs import perf as _perf
 
+    # order matters: the flight dumper and the perf auto-capture run
+    # before the incident manager so the dump AND the profiler capture
+    # are fresh when the incident correlating the same event attaches
+    # its evidence
     _flight.install_bus_subscriber(bus)
+    _perf.install_bus_subscriber(bus)
     _incidents.install(bus)
     default_registry().register_provider("events", bus.snapshot)
 
@@ -363,3 +371,6 @@ def reset() -> None:
     flight = sys.modules.get("raft_tpu.obs.flight")
     if flight is not None:
         flight._on_bus_reset()
+    perf = sys.modules.get("raft_tpu.obs.perf")
+    if perf is not None:
+        perf._on_bus_reset()

@@ -252,10 +252,27 @@ def slo_check(slo_health: Optional[Dict[str, object]]) -> Dict[str, object]:
     return _check(OK, "budgets healthy")
 
 
+def perf_check(perf: Optional[Dict[str, object]]) -> Dict[str, object]:
+    """Fold a :meth:`~raft_tpu.obs.perf.PerfLedger.health_slice` into a
+    health check: a device-time regression still inside its debounce
+    window is DEGRADED — the executable answers, but slower than its own
+    baseline, and the auto-captured profile is waiting to be read."""
+    if not perf:
+        return _check(OK, "perf ledger off or no dispatches yet")
+    active = list(perf.get("active_regressions") or ())
+    if active:
+        return _check(
+            DEGRADED,
+            "device-time regression on: " + ", ".join(sorted(active)),
+        )
+    return _check(OK, "no active device-time regressions")
+
+
 def build_report(
     probes: Dict[str, IndexProbe],
     registry: Optional[MetricsRegistry] = None,
     slo: Optional[Dict[str, object]] = None,
+    perf: Optional[Dict[str, object]] = None,
 ) -> Dict[str, object]:
     """Assemble the service-wide report and publish ``raft_tpu_health``.
 
@@ -286,6 +303,9 @@ def build_report(
     budget = slo_check(slo) if slo is not None else None
     if budget is not None:
         statuses.append(budget["status"])
+    perf_c = perf_check(perf) if perf is not None else None
+    if perf_c is not None:
+        statuses.append(perf_c["status"])
     overall = worst(mem["status"], *statuses)
     gauge.set(VERDICT_VALUES[overall], index="overall")
     with _transition_lock:
@@ -311,4 +331,6 @@ def build_report(
     }
     if budget is not None:
         report["slo"] = budget
+    if perf_c is not None:
+        report["perf"] = perf_c
     return report

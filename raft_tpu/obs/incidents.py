@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional
 from raft_tpu.core import env as _env
 from raft_tpu.core.trace import traced
 from raft_tpu.obs import flight as _flight
+from raft_tpu.obs import profiler as _profiler
 from raft_tpu.obs import spans as _spans
 from raft_tpu.obs.events import Event, EventBus, TRIGGER_KINDS
 from raft_tpu.obs.registry import default_registry
@@ -113,6 +114,7 @@ class Incident:
         self.context_open = context
         self.context_close: Optional[Dict[str, object]] = None
         self.flight: Optional[Dict[str, object]] = None
+        self.capture: Optional[Dict[str, object]] = None
         self.last_event_mono = time.monotonic()
         self.last_event_t = trigger.t
 
@@ -132,6 +134,7 @@ class Incident:
             "context_open": self.context_open,
             "context_close": self.context_close,
             "flight": self.flight,
+            "capture": self.capture,
         }
 
     def summary(self) -> Dict[str, object]:
@@ -144,6 +147,7 @@ class Incident:
             "resolution": self.resolution,
             "events": len(self.timeline),
             "flight": (self.flight or {}).get("path"),
+            "capture": (self.capture or {}).get("path"),
         }
 
     def trace_events(self) -> List[Dict[str, object]]:
@@ -238,13 +242,14 @@ class IncidentManager:
         is_trigger = event.kind in TRIGGER_KINDS and not event.recovered
         context = self._capture_context() if is_trigger else None
         dump = _flight.last_dump()
+        capture = _profiler.last_capture()
         opened = None
         dropped = False
         with self._lock:
             to_close = self._sweep_locked(now)
             target = self._match_locked(now)
             if target is not None:
-                self._append_locked(target, event, dump, now)
+                self._append_locked(target, event, dump, capture, now)
             elif is_trigger:
                 if len(self._open) >= self._max_open:
                     self._dropped += 1
@@ -252,6 +257,7 @@ class IncidentManager:
                 else:
                     opened = Incident(next(self._iid), event, context)
                     self._attach_flight_locked(opened, event, dump)
+                    self._attach_capture_locked(opened, event, capture)
                     self._open.append(opened)
                     self._opened_total += 1
             # a context/recovery event with no fresh incident: not a story
@@ -280,6 +286,7 @@ class IncidentManager:
 
     def _append_locked(self, inc: Incident, event: Event,
                        dump: Optional[Dict[str, object]],
+                       capture: Optional[Dict[str, object]],
                        now: float) -> None:
         inc.timeline.append(event.to_dict())
         inc.last_event_mono = now
@@ -287,6 +294,7 @@ class IncidentManager:
         if event.recovered and inc.recovered_unix is None:
             inc.recovered_unix = event.unix_time
         self._attach_flight_locked(inc, event, dump)
+        self._attach_capture_locked(inc, event, capture)
 
     def _attach_flight_locked(self, inc: Incident, event: Event,
                               dump: Optional[Dict[str, object]]) -> None:
@@ -308,6 +316,29 @@ class IncidentManager:
             "unix_time": dump.get("unix_time"),
             "path": dump.get("path"),
             "trace_path": dump.get("trace_path"),
+        })
+
+    def _attach_capture_locked(self, inc: Incident, event: Event,
+                               capture: Optional[Dict[str, object]]) -> None:
+        # Same contract as flight dumps: the perf auto-capture subscriber
+        # runs before us in bus order, so a capture this event triggered
+        # already started; attach only a fresh one, once.
+        if capture is None:
+            return
+        if abs(event.unix_time - float(capture["unix_time"])) > \
+                max(self._window_s, 1.0):
+            return
+        if inc.capture is not None and \
+                inc.capture.get("path") == capture["path"]:
+            return
+        inc.capture = capture
+        inc.timeline.append({
+            "kind": "profile_capture",
+            "reason": capture.get("reason"),
+            "t": event.t,
+            "unix_time": capture.get("unix_time"),
+            "path": capture.get("path"),
+            "duration_s": capture.get("duration_s"),
         })
 
     # -- closing -------------------------------------------------------------

@@ -76,9 +76,11 @@ import numpy as np
 
 from raft_tpu.core import env as _env
 from raft_tpu.core.trace import trace_range
+from raft_tpu import kernels as _kernels
 from raft_tpu.kernels.toolkit import next_pow2
 from raft_tpu.obs import events as obs_events
 from raft_tpu.obs import flight, slowlog, spans
+from raft_tpu.obs import perf as obs_perf
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
 from raft_tpu.serve.overload import expire_deadlines, validate_priority
 
@@ -123,12 +125,15 @@ class _InFlight:
     __slots__ = (
         "batch", "padded", "n", "bucket", "queue_waits", "t_pad",
         "inflight_wait", "t_dispatch", "t_enqueued", "dist", "ids",
-        "compiles", "sp", "done", "seq", "t_pickup",
+        "compiles", "sp", "done", "seq", "t_pickup", "hedged",
+        "kernel_path",
     )
 
     def __init__(self, batch: List[_Request]):
         self.batch = batch
         self.done = threading.Event()
+        self.hedged = False
+        self.kernel_path = "unknown"
 
 
 class MicroBatcher:
@@ -197,6 +202,15 @@ class MicroBatcher:
         ``hedger`` (a :class:`~raft_tpu.serve.overload.
         HedgedDispatcher`) reroutes batches carrying priority-0 traffic
         through a raced two-member dispatch; warmup warms every member.
+    perf_meta:
+        Optional zero-argument callable returning ``(backend, version)``
+        strings for the perf-ledger executable key — the service points
+        this at its registry so every dispatch is attributed to the
+        index *kind and version* actually serving it.  Standalone
+        batchers default to ``("unknown", "0")``.  The ledger itself
+        (:mod:`raft_tpu.obs.perf`) rides the stage stamps this class
+        already takes — ``RAFT_TPU_PERF_LEDGER=0`` disables it, sampled
+        once at construction so the hot path never re-reads env.
     """
 
     def __init__(
@@ -216,6 +230,7 @@ class MicroBatcher:
         admission=None,
         degraded=None,
         hedger=None,
+        perf_meta: Optional[Callable[[], Tuple[str, str]]] = None,
     ):
         if dim <= 0:
             raise ValueError(f"dim must be positive, got {dim}")
@@ -263,6 +278,23 @@ class MicroBatcher:
             admission.metrics = self.metrics
         if hedger is not None and hedger.metrics is None:
             hedger.metrics = self.metrics
+        if hedger is not None and hedger.on_interval is None:
+            # mirrored hedge members report their device windows here so
+            # device_busy_s() merges the pair instead of double-counting
+            hedger.on_interval = self._note_device_interval
+        # -- measured perf ledger (obs.perf) ---------------------------------
+        # enabled() is sampled ONCE: the hot path holds either a ledger
+        # reference or None, never an env read
+        self._perf = obs_perf.default_ledger() if obs_perf.enabled() else None
+        self._perf_meta = (
+            perf_meta if perf_meta is not None else (lambda: ("unknown", "0"))
+        )
+        # attribution fallback when the search fn did not stamp a routing
+        # choice this dispatch (e.g. hedged members run on pool threads,
+        # whose thread-local stamps this thread cannot see)
+        self._kpath_default = "pallas" if _kernels.use_pallas() else "xla"
+        self._last_kernel_path = self._kpath_default
+        self._last_hedged = False
 
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
@@ -383,12 +415,38 @@ class MicroBatcher:
     def _invoke(self, padded: np.ndarray, batch: List[_Request]):
         """Hand one padded bucket to the search fn (or, for batches
         carrying priority-0 traffic with a hedger installed, to the
-        raced two-member dispatch)."""
+        raced two-member dispatch).
+
+        Side channel: records whether this dispatch was hedged and which
+        ``kernel_path`` the search fn stamped (``kernels.
+        stamp_kernel_path`` in the neighbors routing code) on
+        ``self._last_hedged`` / ``self._last_kernel_path`` — safe as
+        instance state because every call site holds ``_dispatch_lock``.
+        """
         args = self._invoke_args(padded, batch)
         hedger = self.hedger
-        if hedger is not None and any(r.priority == 0 for r in batch):
-            return hedger.dispatch(*args)
-        return self._search_fn(*args)
+        hedged = hedger is not None and any(r.priority == 0 for r in batch)
+        self._last_hedged = hedged
+        _kernels.consume_kernel_path()  # drop any stale stamp first
+        if hedged:
+            out = hedger.dispatch(*args)
+        else:
+            out = self._search_fn(*args)
+        self._last_kernel_path = _kernels.consume_kernel_path(
+            self._kpath_default
+        )
+        return out
+
+    def _note_device_interval(self, t_start: float, t_end: float) -> None:
+        """Merge one device window ``[t_start, t_end]`` into the busy-time
+        union.  This is the hedger's ``on_interval`` sink: each member of
+        a mirrored hedge pair reports its own window, and the incremental
+        union counts their overlap ONCE — so ``device_busy_s()`` stays an
+        upper-bounded union instead of double-counting the race."""
+        with self._inflight_lock:
+            if t_end > self._busy_until:
+                self._busy_s += t_end - max(t_start, self._busy_until)
+                self._busy_until = t_end
 
     def _result_view(self, req: _Request, dist: np.ndarray, ids: np.ndarray,
                      off: int):
@@ -414,6 +472,18 @@ class MicroBatcher:
                 index=self.metrics.name or "default",
                 bucket=str(bucket),
             )
+            if (
+                self._perf is not None
+                and report.flops is not None
+                and report.bytes_accessed is not None
+            ):
+                # analytical per-dispatch cost for the ledger's measured
+                # roofline: keyed (index, bucket) — shapes are identical
+                # across kernel paths and versions
+                self._perf.register_cost(
+                    self.metrics.name or "default", int(bucket),
+                    report.flops, report.bytes_accessed,
+                )
         except Exception:  # noqa: BLE001 — accounting must not fail warmup
             pass
 
@@ -867,7 +937,17 @@ class MicroBatcher:
                 "device": (t2 - t1,),
             },
             request_ids=[r.req_id for r in batch],
+            kernel_path=self._last_kernel_path,
         )
+        if self._perf is not None:
+            # ledger entry rides the t1/t2 stamps already taken above —
+            # zero new clock calls on the hot path
+            backend, ver = self._perf_meta()
+            self._perf.record(
+                index=self.metrics.name or "default", backend=backend,
+                bucket=bucket, kernel_path=self._last_kernel_path,
+                version=ver, device_s=t2 - t1, rows=n, padded_rows=bucket,
+            )
         self._record_flight(
             seq=seq, batch=batch, n=n, bucket=bucket, compiles=compiles,
             t_pickup=t_start, t_done=done,
@@ -1007,6 +1087,8 @@ class MicroBatcher:
                 # the bracket closes here, not after the device wait
                 rec.compiles = compile_count(thread=True) - c0
                 rec.dist, rec.ids = dist, ids
+                rec.hedged = self._last_hedged
+                rec.kernel_path = self._last_kernel_path
             except Exception as exc:  # noqa: BLE001 — fail only this batch
                 spans.finish_span(rec.sp)
                 self._inflight_sem.release()
@@ -1093,11 +1175,14 @@ class MicroBatcher:
             return
         t_device = t4 - t3
         # device-busy union for the idle-fraction estimate: FIFO completion
-        # means intervals arrive ordered by start time
-        with self._inflight_lock:
-            if t4 > self._busy_until:
-                self._busy_s += t4 - max(rec.t_enqueued, self._busy_until)
-                self._busy_until = t4
+        # means intervals arrive ordered by start time.  Hedged batches
+        # already reported their members' windows via _note_device_interval
+        # — adding [t_enqueued, t4] again would double-count the pair.
+        if not rec.hedged:
+            with self._inflight_lock:
+                if t4 > self._busy_until:
+                    self._busy_s += t4 - max(rec.t_enqueued, self._busy_until)
+                    self._busy_until = t4
         if rec.sp is not None:
             rec.sp.add_stage("queue", max(rec.queue_waits, default=0.0))
             rec.sp.add_stage("pad", rec.t_pad)
@@ -1133,7 +1218,18 @@ class MicroBatcher:
                 "device": (t_device,),
             },
             request_ids=[r.req_id for r in batch],
+            kernel_path=rec.kernel_path,
         )
+        if self._perf is not None:
+            # same t3/t4 stamps the "device" stage above is built from, so
+            # per-key ledger totals reconcile with stage_totals()["device"]
+            backend, ver = self._perf_meta()
+            self._perf.record(
+                index=self.metrics.name or "default", backend=backend,
+                bucket=rec.bucket, kernel_path=rec.kernel_path,
+                version=ver, device_s=t_device, rows=rec.n,
+                padded_rows=rec.bucket,
+            )
         self._record_flight(
             seq=rec.seq, batch=batch, n=rec.n, bucket=rec.bucket,
             compiles=rec.compiles,

@@ -132,6 +132,8 @@ class ServingMetrics:
         self._pipeline_depth = 1   # in-flight window size (1 = serial)
         self._inflight = 0         # device batches currently in flight
         self._inflight_peak = 0    # high-water mark of the above
+        # kernel_path → dispatched batches: the live pallas/xla A/B tally
+        self._kernel_paths: Dict[str, int] = {}
         if name is not None:
             obs.default_registry().register_provider(
                 f"serve.{name}", self.snapshot
@@ -155,18 +157,26 @@ class ServingMetrics:
         compiles: int,
         stages: Optional[Mapping[str, Iterable[float]]] = None,
         request_ids: Optional[Iterable[int]] = None,
+        kernel_path: Optional[str] = None,
     ) -> None:
         """One dispatched batch: ``latencies_s`` holds one submit→complete
         latency per coalesced request (queue wait included); ``stages``
         maps stage name → iterable of per-batch (or per-request, for
         ``queue``) stage durations in seconds; ``request_ids`` (parallel
         to ``latencies_s``) attaches each latency observation's request id
-        as a histogram exemplar, so a fat p99 bucket names the request."""
+        as a histogram exemplar, so a fat p99 bucket names the request;
+        ``kernel_path`` is the leg the dispatch actually routed to
+        (pallas/xla/...), stamped live by the kernels thread-local and
+        carried as a label on the latency and stage histograms."""
         now = time.perf_counter()
         with self._lock:
             self.requests += len(latencies_s)
             self.batches += 1
             self.recompiles += compiles
+            if kernel_path is not None:
+                self._kernel_paths[kernel_path] = (
+                    self._kernel_paths.get(kernel_path, 0) + 1
+                )
             self._fill_real += n_real_rows
             self._fill_padded += bucket_rows
             self._pad_waste += max(0, bucket_rows - n_real_rows)
@@ -184,15 +194,22 @@ class ServingMetrics:
                     for v in vals:
                         dq.append(float(v))
         self._mirror_batch(n_real_rows, bucket_rows, latencies_s, compiles,
-                           stages, request_ids)
+                           stages, request_ids, kernel_path)
 
     def _mirror_batch(self, n_real_rows, bucket_rows, latencies_s, compiles,
-                      stages, request_ids=None) -> None:
+                      stages, request_ids=None, kernel_path=None) -> None:
         """Feed the obs registry (no-op for anonymous instances)."""
         if self.name is None:
             return
         reg = obs.default_registry()
         label = {"index": self.name}
+        # latency/stage histograms carry the dispatch's kernel leg so the
+        # pallas-vs-xla comparison reads straight off the live series;
+        # counters keep index-only labels (cardinality discipline)
+        hist_label = (
+            dict(label, kernel_path=kernel_path)
+            if kernel_path is not None else label
+        )
         reg.counter(
             "raft_tpu_serve_requests_total", help="served requests"
         ).inc(len(latencies_s), **label)
@@ -213,7 +230,7 @@ class ServingMetrics:
             # the request id rides along as a per-bucket exemplar: the
             # OpenMetrics scrape links the bucket to a flight-recorder entry
             ex = f"req-{ids[i]}" if ids is not None and i < len(ids) else None
-            lat_h.observe(lat, exemplar=ex, **label)
+            lat_h.observe(lat, exemplar=ex, **hist_label)
         reg.counter(
             "raft_tpu_serve_pad_waste_rows",
             help="padding rows dispatched but never asked for (bucket "
@@ -227,7 +244,7 @@ class ServingMetrics:
             )
             for s, vals in stages.items():
                 for v in vals:
-                    st_h.observe(v, stage=s, **label)
+                    st_h.observe(v, stage=s, **hist_label)
             queue = [float(v) for v in stages.get("queue", ())]
             if queue:
                 reg.gauge(
@@ -325,6 +342,8 @@ class ServingMetrics:
                     str(b): (f[0] / f[1] if f[1] else None)
                     for b, f in sorted(self._bucket_fill.items())
                 },
+                # dispatched batches per routed kernel leg (live A/B)
+                "kernel_paths": dict(self._kernel_paths),
             }
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50) * 1e3)

@@ -510,6 +510,11 @@ class HedgedDispatcher:
         self.metrics = metrics
         self.fired_total = 0
         self.hedge_wins = 0
+        # optional per-member device-interval sink, ``fn(t_start, t_end)``
+        # in perf_counter seconds: the batcher points this at its busy
+        # interval-union so a mirrored hedge pair's overlapping device
+        # windows MERGE instead of double-counting in device_busy_s()
+        self.on_interval: Optional[Callable[[float, float], None]] = None
 
     def delay_s(self) -> float:
         """Hedge delay: ``p99 × mult`` from the live latency reservoir,
@@ -539,8 +544,14 @@ class HedgedDispatcher:
 
         def run(i: int) -> None:
             try:
+                t_s = time.perf_counter()
                 out = self.members[i](*args)
                 jax.block_until_ready(out)  # raft-tpu: ignore[HOSTSYNC] winner selection needs device completion
+                sink = self.on_interval
+                if sink is not None:
+                    # report THIS member's device window; the union sink
+                    # dedupes the mirrored pair's overlap
+                    sink(t_s, time.perf_counter())
             except Exception as exc:  # noqa: BLE001 — raced, re-raised below
                 with lock:
                     state["errors"].append(exc)

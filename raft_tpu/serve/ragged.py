@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import kernels as _kernels
 from raft_tpu.core import env as _env
 from raft_tpu.core.bitset import Bitset, RowFilter
 from raft_tpu.core.trace import traced
@@ -229,6 +230,9 @@ class RaggedSearcher:
                     f"{type(index).__name__}; serve it with "
                     "RaggedSpec(filters=False)"
                 )
+            # perf-ledger attribution: the SPMD body traces once, so the
+            # routing stamp happens here on the host, not inside search
+            _kernels.stamp_kernel_path("sharded")
             dist, ids = index.search(queries, self._spec.k_max)
             select_min = DISTANCE_TYPES[index.metric] != "inner_product"
             return mask_row_k(dist, ids, row_k, select_min=select_min)

@@ -39,6 +39,7 @@ from raft_tpu.core.trace import traced
 from raft_tpu.obs import cost as obs_cost
 from raft_tpu.obs import health as obs_health
 from raft_tpu.obs import incidents as obs_incidents
+from raft_tpu.obs import perf as obs_perf
 from raft_tpu.obs import slo as obs_slo
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.serve.batcher import MicroBatcher
@@ -247,6 +248,7 @@ class SearchService:
                 admission=admission,
                 degraded=degraded,
                 hedger=hedger,
+                perf_meta=self._make_perf_meta(name),
             )
             self._batchers[name] = batcher
         if old is not None:
@@ -276,6 +278,22 @@ class SearchService:
             return index.search(queries, k)
 
         return search_fn
+
+    def _make_perf_meta(self, name: str):
+        """``(backend, version)`` supplier for the perf ledger's
+        executable key.  Resolved per dispatch, so a hot-swap
+        re-attributes device time to the successor kind/version from its
+        first batch — the ledger's A/B story survives swaps."""
+
+        def perf_meta():
+            try:
+                index, version = self.registry.get_versioned(name)
+            except KeyError:  # removed mid-flight
+                return ("unknown", "0")
+            return (getattr(index, "kind", "unknown") or "unknown",
+                    str(version))
+
+        return perf_meta
 
     def _make_observer(self, name: str):
         """Batcher observer feeding the quality auditor, if any.
@@ -534,6 +552,12 @@ class SearchService:
             obs_cost.refresh_mutation_gauges(self.registry)
         except Exception:  # mutation pressure gauges likewise
             pass
+        try:
+            # wasted-time fraction + measured roofline utilization per
+            # executable key — pull-refreshed on the same scrape path
+            obs_perf.default_ledger().refresh_gauges()
+        except Exception:  # perf accounting must never break serving
+            pass
 
     def _incident_context(self) -> Dict[str, object]:
         """Snapshot attached to incident timelines at open/close.
@@ -581,6 +605,10 @@ class SearchService:
         With an SLO engine attached (``slo=`` knob) the report also folds
         in the error-budget check: an exhausted budget is DEGRADED, and
         the detail names the offending objectives under ``slo``.
+
+        The measured perf ledger folds in the same way: an executable key
+        inside its regression-debounce window (a live ``perf_regression``)
+        reports DEGRADED under the report's ``perf`` key.
         """
         self._refresh_capacity_gauges()
         auditor = self.auditor
@@ -631,6 +659,7 @@ class SearchService:
                 self.slo_engine.health()
                 if self.slo_engine is not None else None
             ),
+            perf=obs_perf.default_ledger().health_slice(),
         )
 
     def readyz(self) -> Dict[str, object]:
@@ -657,6 +686,9 @@ class SearchService:
             "indexes": {n: self.stats(n) for n in self.names()},
             "health": self.healthz(),
             "registry": obs.snapshot(),
+            # measured perf ledger, surfaced at the top level too (it also
+            # rides registry["perf"]): hotspot ranking + regression state
+            "perf": obs_perf.default_ledger().snapshot(),
         }
         if self.slo_engine is not None:
             out["slo"] = self.slo_engine.snapshot()

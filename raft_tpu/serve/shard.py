@@ -530,6 +530,65 @@ class ShardedIndex:
                 "(sharded arrays at 1/N + replicated sidecars)",
             ).set(float(per_bytes[s]), **labels)
 
+    def measure_shard_skew(self, queries, k: int) -> Dict[str, object]:
+        """Per-shard device-time probe — straggler detection.
+
+        The production :meth:`search` is ONE shard_map dispatch: the
+        slowest shard paces every other, and per-shard time is invisible
+        from the host.  This probe runs the *same* per-shard core search
+        (Pallas legs included) over each shard's partition individually —
+        warmed, then timed — and publishes
+        ``raft_tpu_shard_device_seconds{index,shard}`` plus the max/mean
+        straggler factor ``raft_tpu_shard_device_skew{index}``.  A skew
+        near 1.0 means the round-robin partitioning is balanced; a high
+        skew names the shard throttling the whole SPMD step.
+
+        Deliberately off the hot path (operator / bench entry): compiles
+        and syncs spent here never touch the batcher's zero-recompile
+        contract or the serve-stage timers.
+        """
+        queries = jnp.asarray(queries, jnp.float32)
+        if queries.ndim != 2 or queries.shape[1] != self.dim:
+            raise ValueError(
+                f"queries shape {queries.shape} vs index dim {self.dim}"
+            )
+        npb, pool = self._local_pool()
+        kk = min(int(k), pool)
+        core = jax.jit(self._make_shard_search(kk, npb))
+        times = []
+        with trace_range("serve.shard_skew"):
+            for s in range(self.n_shards):
+                # sharded parts contribute this shard's slice (leading
+                # axis kept — the core squeezes it, exactly as the
+                # shard_map body would); replicated parts ride whole
+                args = tuple(
+                    self._parts[n][s : s + 1]
+                    if self._specs[n] and self._specs[n][0] is not None
+                    else self._parts[n]
+                    for n in self._names
+                )
+                out = core(queries, *args)
+                jax.block_until_ready(out)  # raft-tpu: ignore[HOSTSYNC] probe warmup barrier
+                t0 = time.perf_counter()
+                out = core(queries, *args)
+                jax.block_until_ready(out)  # raft-tpu: ignore[HOSTSYNC] probe timing barrier
+                times.append(time.perf_counter() - t0)
+        reg = obs.default_registry()
+        for s, dt in enumerate(times):
+            reg.gauge(
+                "raft_tpu_shard_device_seconds",
+                help="measured per-shard seconds for one probe search, "
+                "dispatched individually outside the SPMD step",
+            ).set(float(dt), index=self.label, shard=str(s))
+        mean = sum(times) / len(times)
+        skew = (max(times) / mean) if mean > 0.0 else 1.0
+        reg.gauge(
+            "raft_tpu_shard_device_skew",
+            help="max/mean of the per-shard probe times — the straggler "
+            "factor pacing the real sharded dispatch",
+        ).set(float(skew), index=self.label)
+        return {"per_shard_s": times, "skew": skew}
+
 
 def _infer_kind(index) -> str:
     mod = type(index).__module__.rsplit(".", 1)[-1]

@@ -567,3 +567,48 @@ def test_slo_engine_overhead_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_perf_ledger_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the measured-perf-ledger A/B: run ``bench.py perf``
+    (pacing-dominated ledger-off/on rounds, then live attribution on a
+    real served index, then a forced ~8x device slowdown) and gate it
+    with ``bench.py compare`` against the frozen record.  The run must
+    show zero hot-path recompiles in both overhead arms, the ledger
+    within tolerance of free (the <2% acceptance bar plus single-core CI
+    scheduling noise), the served executable attributed as a hotspot
+    with a measured roofline in (0, 1], and the full regression evidence
+    chain: exactly one debounced ``perf_regression`` that triggered one
+    profiler capture and landed in one correlated incident."""
+    candidate = str(tmp_path / "perf_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "perf"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "perf leg recompiled on the hot path"
+    assert line["qps_ratio"] >= 0.90, (
+        f"perf ledger overhead out of tolerance: {line['overhead_pct']}%"
+    )
+    hot = line["hotspot"]
+    assert hot["index"] == "perf_bench" and hot["backend"] == "brute_force"
+    assert hot["kernel_path"] == "xla"
+    assert 0.0 < line["roofline_utilization"] <= 1.0
+    chain = line["regression_chain"]
+    assert chain["events"] == 1 and chain["capture"] and chain["incident"]
+    assert chain["ratio"] > 1.5 and chain["regressions_on_key"] == 1
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_perf_r13.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

@@ -542,12 +542,15 @@ class TestServiceOverload:
 
 def test_overload_event_taxonomy():
     for kind in ("admission_shed", "degraded_enter", "degraded_exit",
-                 "hedge_fired"):
+                 "hedge_fired", "perf_regression"):
         assert kind in events.KINDS
     # shed + degrade decisions open incidents; exits/hedges annotate
     assert "admission_shed" in events.TRIGGER_KINDS
     assert "degraded_enter" in events.TRIGGER_KINDS
     assert "degraded_exit" not in events.TRIGGER_KINDS
     assert "hedge_fired" not in events.TRIGGER_KINDS
+    # a measured device-time regression opens an incident (and triggers
+    # the debounced profiler capture on the way)
+    assert "perf_regression" in events.TRIGGER_KINDS
     with pytest.raises(ValueError):
         events.publish("admission_shedd")  # typos fail loudly, not vanish

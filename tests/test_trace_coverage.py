@@ -49,10 +49,10 @@ def test_entry_point_discovery_is_not_vacuous(project):
 
 
 def test_serve_surface_discovery_is_not_vacuous(result):
-    # all twelve online entry points (service/mutation/ragged/compactor
-    # plus the SLO evaluator and incident ingest) checked, against
-    # exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 12, result.stats
+    # all seventeen online entry points (service/mutation/ragged/compactor
+    # plus the SLO evaluator, incident ingest, the overload trio and the
+    # perf-ledger pair) checked, against exactly one MicroBatcher
+    assert result.stats["traced_serve_entries_checked"] == 17, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
     assert result.stats["traced_labels"] >= 20, result.stats
 
