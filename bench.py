@@ -204,6 +204,9 @@ def main() -> None:
     if "slo" in sys.argv[1:]:
         run_slo_leg()
         return
+    if "kernels" in sys.argv[1:]:
+        run_kernels_leg()
+        return
     if "perf" in sys.argv[1:]:
         run_perf_leg()
         return
@@ -1986,6 +1989,211 @@ def run_obs_leg() -> None:
             ),
             "slow_queries": len(snap["slow_queries"]["recent"]),
             "requests": n_requests,
+        }
+    )
+
+
+def run_kernels_leg() -> None:
+    """``python bench.py kernels`` — select_k + CAGRA XLA-vs-Pallas A/B
+    (CPU, interpret mode).
+
+    Off-TPU the Pallas kernels run in interpret mode, which lowers the
+    kernel *body* through XLA — so this leg is an **algorithmic** A/B:
+    the same masked-extraction / fused-hop formulations the TPU runs,
+    wall-clocked honestly against their XLA twins on CPU.  Interpret
+    mode serializes the grid (one (query, parent) step at a time), so
+    the benched shapes sit where the kernels' structural wins dominate
+    that serialization tax rather than at TPU-preferred tilings:
+
+    - **select_k (stable)**: the serving-merge discipline — two-key
+      smallest-id-wins selection with ``input_indices`` — at a tiled
+      brute-force merge shape (32 query rows x 8192 pooled candidates,
+      k=32).  The XLA twin pays a full-width two-key ``lax.sort``; the
+      kernel pays k masked min-extraction rounds over a VMEM-resident
+      row.  Parity is asserted **bitwise** (the kernel's routing
+      contract).  The positional variant is not wall-clocked here: on
+      CPU ``lax.top_k`` is a fast partial selection, so the interpret
+      number would say nothing about the TPU sort-based lowering it
+      replaces.
+    - **cagra_traverse**: a wide-beam regime (itopk=width=128, deg=64,
+      3 hops) where the XLA hop's ``[t, w*deg, d]`` dataset-gather copy
+      and its (itopk + w*deg)-wide two-key merge sort dominate — the
+      exact HBM traffic the fused hop exists to delete.  Parity is
+      asserted as recall equivalence plus row-wise distance agreement
+      (the fused hop's contract; ids may swap only across exact ties).
+
+    Both arms of both A/Bs self-assert zero post-warmup recompiles, and
+    each arm records the ``kernel_path`` it stamped.  A final serving
+    phase drives a CAGRA-backed ``SearchService`` with the kernels
+    enabled and asserts the PerfLedger attributes its device seconds to
+    a ``kernel_path="pallas"`` hotspot key with a measured roofline —
+    the record's top-level ``kernel_path`` stamps ``pallas: true``.
+    Gated by ``bench.py compare`` against the frozen record
+    (``benchmarks/BENCH_kernels_r15.json``).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import kernels, obs, serve
+    from raft_tpu.bench.export import kernel_path
+    from raft_tpu.neighbors import brute_force, cagra
+    from raft_tpu.obs import perf
+    from raft_tpu.ops import matrix
+    from raft_tpu.serve.metrics import compile_count
+
+    obs.install()
+    rng = np.random.default_rng(15)
+    saved_pallas = os.environ.get("RAFT_TPU_PALLAS")
+
+    def measure(fn, *args, iters=5):
+        """(mean_seconds, outputs) with a zero-recompile self-assert:
+        warmup compiles, the timed iterations must not."""
+        for _ in range(2):
+            out = jax.block_until_ready(fn(*args))
+        c0 = compile_count()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        assert compile_count() - c0 == 0, "timed iterations recompiled"
+        return dt, out
+
+    # -- select_k (stable serving-merge discipline) --------------------------
+    rows, n, k = 32, 8192, 32
+    s = jnp.asarray(np.round(rng.standard_normal((rows, n)) * 3).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 1_000_000, size=(rows, n)).astype(np.int32))
+
+    def sk_arm(pallas: bool):
+        # a fresh jit closure per arm: the routing branch is resolved at
+        # trace time from the env, exactly like the serving call sites
+        os.environ["RAFT_TPU_PALLAS"] = "1" if pallas else "0"
+        fn = jax.jit(lambda sc, si: matrix.select_k_stable(sc, k, input_indices=si))
+        dt, (v, i) = measure(fn, s, ids)
+        return dt, np.asarray(v), np.asarray(i)
+
+    t_sk_xla, v0, i0 = sk_arm(False)
+    t_sk_pal, v1, i1 = sk_arm(True)
+    np.testing.assert_array_equal(v0, v1)  # bitwise: values
+    np.testing.assert_array_equal(i0, i1)  # bitwise: ids
+    sk_speedup = t_sk_xla / t_sk_pal
+    assert sk_speedup > 1.0, (
+        f"select_k pallas arm did not beat its XLA twin: {sk_speedup:.2f}x"
+    )
+
+    # -- cagra_traverse (wide-beam fused hop) --------------------------------
+    nd, d, n_q, kq = 8000, 192, 8, 10
+    x = rng.normal(size=(nd, d)).astype(np.float32)
+    q = x[rng.choice(nd, n_q, replace=False)] + rng.normal(
+        0, 0.3, (n_q, d)
+    ).astype(np.float32)
+    built = cagra.build(
+        cagra.IndexParams(
+            intermediate_graph_degree=96, graph_degree=64,
+            build_algo="brute_force",
+        ),
+        x,
+    )
+    _, gt = brute_force.knn(x, q, kq)
+    sp = cagra.SearchParams(itopk_size=128, search_width=128, max_iterations=3)
+
+    def cagra_arm(pallas: bool):
+        os.environ["RAFT_TPU_PALLAS"] = "1" if pallas else "0"
+        dt, (dist, idx) = measure(
+            lambda qq: cagra.search(sp, built, qq, kq), q, iters=3
+        )
+        stamped = kernels.consume_kernel_path()
+        assert stamped == ("pallas" if pallas else "xla"), stamped
+        return dt, np.asarray(dist), np.asarray(idx), stamped
+
+    t_cg_xla, d0, c0, path0 = cagra_arm(False)
+    t_cg_pal, d1, c1, path1 = cagra_arm(True)
+
+    def recall(idx):
+        hits = sum(
+            len(set(a.tolist()) & set(b.tolist()))
+            for a, b in zip(idx, np.asarray(gt))
+        )
+        return hits / gt.size
+
+    r0, r1 = recall(c0), recall(c1)
+    assert abs(r0 - r1) <= 0.02, (r0, r1)
+    np.testing.assert_allclose(d0, d1, rtol=1e-5, atol=1e-5)
+    cg_speedup = t_cg_xla / t_cg_pal
+    assert cg_speedup > 1.0, (
+        f"cagra pallas arm did not beat its XLA twin: {cg_speedup:.2f}x"
+    )
+
+    # -- serving-path attribution: pallas keys in the perf ledger ------------
+    os.environ["RAFT_TPU_PALLAS"] = "1"
+    svc = serve.SearchService(k=kq, max_batch=8, min_bucket=8, max_delay_ms=0.5)
+    svc.add_index("kernels_bench", built, warmup=True)
+    futs = [svc.submit("kernels_bench", q[i % n_q : i % n_q + 2]) for i in range(24)]
+    svc.flush("kernels_bench")
+    for f in futs:
+        f.result(timeout=300)
+    st = svc.stats("kernels_bench")
+    assert st["recompiles"] == 0, st
+    mine = [
+        h for h in perf.default_ledger().top_hotspots(n=64)
+        if h["index"] == "kernels_bench"
+    ]
+    assert mine, "served cagra executable never showed up as a hotspot"
+    pal = [h for h in mine if h["kernel_path"] == "pallas"]
+    assert pal, f"no pallas-keyed hotspot rows: {[h['kernel_path'] for h in mine]}"
+    assert all(h["backend"] == "cagra" for h in pal), pal
+    dev_s = sum(h["device_s"] for h in pal)
+    assert dev_s > 0.0, pal
+    utils = [
+        h["roofline_utilization"] for h in pal
+        if h.get("roofline_utilization") is not None
+    ]
+    assert utils and all(0.0 < u <= 1.0 for u in utils), (
+        f"pallas keys missing a measured roofline in (0, 1]: {utils}"
+    )
+    svc.stop()
+    if saved_pallas is None:
+        os.environ.pop("RAFT_TPU_PALLAS", None)
+    else:
+        os.environ["RAFT_TPU_PALLAS"] = saved_pallas
+
+    _emit(
+        {
+            "metric": f"kernels_cagra_pallas_qps_n{nd // 1000}k_d{d}_w128",
+            "value": round(n_q / t_cg_pal, 2),
+            "unit": "queries/s",
+            "platform": "cpu",
+            "recall": round(r1, 4),
+            "recompiles": 0,
+            "interpret_mode": True,
+            "select_k": {
+                "rows": rows, "n": n, "k": k,
+                "xla": {"ms": round(t_sk_xla * 1e3, 3), "kernel_path": "xla"},
+                "pallas": {"ms": round(t_sk_pal * 1e3, 3), "kernel_path": "pallas"},
+                "speedup": round(sk_speedup, 3),
+                "parity": "bitwise",
+            },
+            "cagra_traverse": {
+                "n": nd, "d": d, "n_q": n_q, "graph_degree": 64,
+                "itopk": 128, "search_width": 128, "max_iterations": 3,
+                "xla": {"ms": round(t_cg_xla * 1e3, 3), "kernel_path": path0,
+                        "recall": round(r0, 4)},
+                "pallas": {"ms": round(t_cg_pal * 1e3, 3), "kernel_path": path1,
+                           "recall": round(r1, 4)},
+                "speedup": round(cg_speedup, 3),
+                "parity": "recall+distances",
+            },
+            "serving": {
+                "backend": "cagra",
+                "pallas_hotspot_device_s": round(dev_s, 6),
+                "roofline_utilization": round(max(utils), 6),
+                "recompiles": st["recompiles"],
+            },
+            "kernel_path": kernel_path(pallas=True),
         }
     )
 

@@ -68,6 +68,14 @@ SERVE_ENTRY_POINTS = {
     ("obs.perf.PerfLedger", "evaluate"): "perf.evaluate",
 }
 
+#: the closed ``kernel_path`` vocabulary (tabulated in docs/kernels.md) —
+#: the batcher, the perf ledger's hotspot keys, and the bench records all
+#: treat the stamp as an enum; a stray literal would silently mint a new
+#: ledger key that no dashboard or A/B gate knows to read
+KERNEL_PATH_VOCAB = frozenset(
+    {"pallas", "xla", "xla_filter_fallback", "sharded"}
+)
+
 
 def check(project: Project, result) -> None:
     entry_points = _api_entry_points(project)
@@ -86,6 +94,7 @@ def check(project: Project, result) -> None:
     _check_serve_labels(project, result)
     _check_label_uniqueness(project, result)
     _check_batcher_plumbing(project, result)
+    _check_kernel_dispatch(project, result)
 
 
 # -- API-surface discovery through package __all__ --------------------------
@@ -231,6 +240,79 @@ def _check_label_uniqueness(project: Project, result) -> None:
         else:
             seen[label] = fn.qualname
     result.stats["traced_labels"] = len(seen)
+
+
+# -- kernel dispatch attribution --------------------------------------------
+
+def _stamp_literals(node: ast.AST) -> Optional[List[str]]:
+    """String literals a ``stamp_kernel_path`` argument can evaluate to
+    (handles the ``"a" if cond else "b"`` routing idiom); None when the
+    value is not statically enumerable."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else None
+    if isinstance(node, ast.IfExp):
+        body = _stamp_literals(node.body)
+        orelse = _stamp_literals(node.orelse)
+        if body is None or orelse is None:
+            return None
+        return body + orelse
+    return None
+
+
+def _check_kernel_dispatch(project: Project, result) -> None:
+    """Per-dispatch attribution over the Pallas kernel entry points:
+
+    * every ``stamp_kernel_path(...)`` call stamps a literal from the
+      closed :data:`KERNEL_PATH_VOCAB` (a non-enumerable stamp would mint
+      unreadable ledger keys at runtime);
+    * every ``pallas_call`` under ``kernels.`` carries a
+      ``cost_estimate=`` — without it the dispatch is an opaque custom
+      call with blank flops/bytes/roofline columns in
+      ``PerfLedger.top_hotspots()`` (ops/cost.py owns the formulas).
+    """
+    n_stamps = 0
+    n_calls = 0
+    for mod in sorted(project.modules.values(), key=lambda m: m.name):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if tail.lstrip("_") == "stamp_kernel_path" and node.args:
+                n_stamps += 1
+                vals = _stamp_literals(node.args[0])
+                bad = (
+                    "non-literal kernel_path" if vals is None
+                    else ", ".join(
+                        repr(v) for v in vals if v not in KERNEL_PATH_VOCAB
+                    )
+                )
+                if bad:
+                    f = project.finding(
+                        "TRACED", mod, node, mod.name,
+                        f"stamp_kernel_path({bad}) is outside the closed "
+                        f"vocabulary {sorted(KERNEL_PATH_VOCAB)} — ledger "
+                        "keys and bench A/B gates read the stamp as an "
+                        "enum",
+                        suppressed_sink=result.suppressed,
+                    )
+                    if f is not None:
+                        result.findings.append(f)
+            elif tail == "pallas_call" and ".kernels." in f".{mod.name}.":
+                n_calls += 1
+                if not any(kw.arg == "cost_estimate" for kw in node.keywords):
+                    f = project.finding(
+                        "TRACED", mod, node, mod.name,
+                        "pallas_call without cost_estimate= — the dispatch "
+                        "is an opaque custom call to XLA's cost model, so "
+                        "its pallas ledger key reports blank flops/bytes/"
+                        "roofline (register a formula in ops/cost.py)",
+                        suppressed_sink=result.suppressed,
+                    )
+                    if f is not None:
+                        result.findings.append(f)
+    result.stats["traced_kernel_path_stamps"] = n_stamps
+    result.stats["traced_pallas_cost_estimates"] = n_calls
 
 
 # -- batcher detached-span / request-id plumbing ----------------------------

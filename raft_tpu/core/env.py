@@ -198,6 +198,12 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_PALLAS", "str", "unset",
            "1 routes supported kernels through the Pallas "
            "implementations (kernels.use_pallas also accepts 0/auto)"),
+    EnvVar("RAFT_TPU_PALLAS_SELECT_K", "bool", "1",
+           "0 reverts the fused k-selection kernel to the XLA "
+           "select paths (under the master RAFT_TPU_PALLAS gate)"),
+    EnvVar("RAFT_TPU_PALLAS_CAGRA", "bool", "1",
+           "0 reverts the fused CAGRA traversal hop to the XLA "
+           "while-loop body (under the master RAFT_TPU_PALLAS gate)"),
     EnvVar("RAFT_TPU_HBM_BYTES", "int", "per-platform",
            "device memory budget the planners size against"),
     # -- process bootstrap ---------------------------------------------------

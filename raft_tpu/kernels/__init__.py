@@ -37,6 +37,20 @@ def use_pallas() -> bool:
     return _platform() == "tpu"
 
 
+def select_k_enabled() -> bool:
+    """Per-kernel revert knob under the master gate: the fused k-selection
+    (kernels/select_k.py) routes from ops.matrix only when ``use_pallas()``
+    AND this knob hold — so a select_k-specific regression can be rolled
+    back without losing the scan kernels."""
+    return _env.env_bool("RAFT_TPU_PALLAS_SELECT_K", True)
+
+
+def cagra_fused_enabled() -> bool:
+    """Per-kernel revert knob for the fused CAGRA hop
+    (kernels/cagra_traverse.py), same contract as ``select_k_enabled``."""
+    return _env.env_bool("RAFT_TPU_PALLAS_CAGRA", True)
+
+
 # ---------------------------------------------------------------------------
 # live kernel-path attribution
 #
@@ -76,13 +90,19 @@ def interpret_mode() -> bool:
 from raft_tpu.kernels.fused_knn import fused_l2_topk  # noqa: E402
 from raft_tpu.kernels.fused_argmin import fused_l2_argmin  # noqa: E402
 from raft_tpu.kernels.ivf_scan import ivf_scan_probe_major  # noqa: E402
+from raft_tpu.kernels.select_k import select_k_pallas  # noqa: E402
+from raft_tpu.kernels.cagra_traverse import cagra_fused_hop  # noqa: E402
 
 __all__ = [
     "use_pallas",
+    "select_k_enabled",
+    "cagra_fused_enabled",
     "interpret_mode",
     "stamp_kernel_path",
     "consume_kernel_path",
     "fused_l2_topk",
     "fused_l2_argmin",
     "ivf_scan_probe_major",
+    "select_k_pallas",
+    "cagra_fused_hop",
 ]

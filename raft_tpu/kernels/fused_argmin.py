@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.ops import cost as ops_cost
+
 _WORST = float("inf")
 
 
@@ -77,9 +79,12 @@ def fused_l2_argmin(
                  constant_values=jnp.inf)[None, :]
 
     grid = ((n + n_pad) // tile_rows, (centers.shape[0] + c_pad) // tile_c)
+    c = ops_cost.fused_argmin_cost(n, centers.shape[0], d)
+    ops_cost.note("fused_argmin", c)
     val, idx = pl.pallas_call(
         functools.partial(_fused_argmin_kernel, tile_c=tile_c),
         grid=grid,
+        cost_estimate=c.as_pallas(),
         in_specs=[
             pl.BlockSpec((tile_rows, d + d_pad), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),

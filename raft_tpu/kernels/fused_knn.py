@@ -34,6 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.kernels.toolkit import col_ids_tile, fold_topk
+from raft_tpu.ops import cost as ops_cost
 
 _WORST = float("inf")
 
@@ -115,9 +116,12 @@ def fused_l2_topk(
 
     grid = ((n_q + q_pad) // tile_q, (n + n_pad) // tile_n)
     kernel = functools.partial(_fused_knn_kernel, k=k, tile_n=tile_n)
+    c = ops_cost.fused_knn_cost(n_q, n, d, k)
+    ops_cost.note("fused_knn", c)
     vals, idx = pl.pallas_call(
         kernel,
         grid=grid,
+        cost_estimate=c.as_pallas(),
         in_specs=[
             pl.BlockSpec((tile_q, d + d_pad), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),

@@ -50,6 +50,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.kernels.toolkit import fold_topk, quantize_queries_i8
+from raft_tpu.ops import cost as ops_cost
 
 _WORST = float("inf")
 
@@ -239,6 +240,10 @@ def ivf_scan_probe_major(
             pl.BlockSpec((1, G, kk), lambda b, bl: (b, 0, 0)),
         ],
     )
+    c = ops_cost.ivf_scan_cost(
+        B, G, cap, rot, kk, itemsize=list_data.dtype.itemsize
+    )
+    ops_cost.note("ivf_scan_probe_major", c)
     vals, ids = pl.pallas_call(
         functools.partial(
             _scan_kernel, kk=kk, metric=metric, filtered=filtered,
@@ -249,6 +254,7 @@ def ivf_scan_probe_major(
             jax.ShapeDtypeStruct((B, G, kk), jnp.float32),
             jax.ShapeDtypeStruct((B, G, kk), jnp.int32),
         ],
+        cost_estimate=c.as_pallas(),
         interpret=interpret,
     )(
         bucket_list,
@@ -439,6 +445,10 @@ def ivf_scan_query_major(
                 pltpu.VMEM((G, P, cap_pad), jnp.int32),
             ],
         )
+        c = ops_cost.ivf_scan_cost(
+            Q * P, 1, cap, rot, kk, itemsize=list_data.dtype.itemsize
+        )
+        ops_cost.note("ivf_scan_query_major", c)
         vals, ids = pl.pallas_call(
             functools.partial(
                 _scan_qm_kernel_fid, kk=kk, metric=metric, filtered=True,
@@ -449,6 +459,7 @@ def ivf_scan_query_major(
                 jax.ShapeDtypeStruct((Q // G, G, kk), jnp.float32),
                 jax.ShapeDtypeStruct((Q // G, G, kk), jnp.int32),
             ],
+            cost_estimate=c.as_pallas(),
             interpret=interpret,
         )(
             probes.reshape(-1),
@@ -503,6 +514,10 @@ def ivf_scan_query_major(
             pltpu.VMEM((G, P, cap_pad), jnp.int32),
         ],
     )
+    c = ops_cost.ivf_scan_cost(
+        Q * P, 1, cap, rot, kk, itemsize=list_data.dtype.itemsize
+    )
+    ops_cost.note("ivf_scan_query_major", c)
     vals, ids = pl.pallas_call(
         functools.partial(
             _scan_qm_kernel, kk=kk, metric=metric, filtered=filtered,
@@ -513,6 +528,7 @@ def ivf_scan_query_major(
             jax.ShapeDtypeStruct((Q // G, G, kk), jnp.float32),
             jax.ShapeDtypeStruct((Q // G, G, kk), jnp.int32),
         ],
+        cost_estimate=c.as_pallas(),
         interpret=interpret,
     )(
         probes.reshape(-1),

@@ -525,12 +525,15 @@ def _gather_rows(dataset, ids):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "metric", "tile"),
+    static_argnames=(
+        "k", "itopk", "width", "max_iter", "min_iter", "metric", "tile",
+        "fused",
+    ),
 )
 def _search_jit(
     dataset, graph, queries, filter_words, seed_ids,
     k: int, itopk: int, width: int, max_iter: int, min_iter: int,
-    metric: str, tile: int,
+    metric: str, tile: int, fused: bool = False,
 ):
     n, d = dataset.shape
     deg = graph.shape[1]
@@ -606,6 +609,23 @@ def _search_jit(
             explored = explored.at[
                 jnp.arange(tile)[:, None], ppos
             ].set(True)
+            if fused:
+                # ---- fused hop: expand + score + dedup + merge ride one
+                # Pallas kernel (kernels/cagra_traverse.py). Only the tiny
+                # [t, w] neighbor-id gather stays in XLA (it doubles as the
+                # kernel's scalar-prefetch operand); the [t, w·deg, d] row
+                # gather, the O(c²) dedup, and the itopk merge sort never
+                # materialize in HBM. The gate in search() keeps filtered
+                # traffic on the XLA body (res-buffer side-merge below).
+                from raft_tpu.kernels import interpret_mode
+                from raft_tpu.kernels.cagra_traverse import cagra_fused_hop
+
+                parents_m = jnp.where(parent_ok, parents, -1)
+                buf_d, buf_i, explored = cagra_fused_hop(
+                    dataset, graph, qs, parents_m, buf_d, buf_i, explored,
+                    metric=metric, interpret=interpret_mode(),
+                )
+                return it + 1, buf_i, buf_d, explored, res_i, res_d
             # ---- expand: gather graph rows (the data-dependent gather)
             nbrs = graph[jnp.clip(parents, 0, n - 1)]             # [t, w, deg]
             nbrs = jnp.where(parent_ok[:, :, None], nbrs, -1)
@@ -756,10 +776,24 @@ def search(
         raise ValueError(
             f"row filter has {fw.shape[0]} rows for {q} queries"
         )
+    # fused-hop gate: filtered traffic keeps the XLA body (the res-buffer
+    # side-merge has no kernel leg), as do compressed datasets and
+    # out-of-envelope itopk.  RAFT_TPU_PALLAS_CAGRA=0 reverts just this
+    # kernel without losing the rest of the Pallas fleet.
+    from raft_tpu import kernels as _kernels
+    from raft_tpu.kernels.cagra_traverse import traverse_supported
+
+    fused = (
+        fw is None
+        and _kernels.use_pallas()
+        and _kernels.cagra_fused_enabled()
+        and traverse_supported(index.dataset, itopk)
+    )
+    _kernels.stamp_kernel_path("pallas" if fused else "xla")
     return _search_jit(
         index.dataset, index.graph, queries, fw, seed_ids,
         int(k), int(itopk), int(width), int(max_iter), int(min_iter),
-        metric, int(tile),
+        metric, int(tile), fused=fused,
     )
 
 

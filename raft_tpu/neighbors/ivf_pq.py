@@ -1258,7 +1258,7 @@ def _search_probe_major_pallas(
 def _search_query_major_pallas(
     queries, centers, rotation, list_data, list_y2, list_index,
     list_filter, scan_scale, n_probes: int, k: int, metric: str,
-    scan_dtype: str, interpret: bool,
+    scan_dtype: str, interpret: bool, query_fid=None,
 ):
     """Query-major schedule with the fused Pallas scan
     (kernels/ivf_scan.ivf_scan_query_major): probed lists stream from
@@ -1266,7 +1266,11 @@ def _search_query_major_pallas(
     [t, p, cap, rot] gather copy and [t, p, cap] score tensor (2× the
     whole scanned volume in extra HBM traffic) never exist.  Queries pad
     to the kernel's group width with q2=+inf rows (outputs -1, sliced
-    off)."""
+    off).
+
+    ``query_fid`` (ragged descriptor leg) selects each query's filter
+    row from a pre-packed [n_filters, L, cap_w] ``list_filter`` table;
+    padding rows ride fid 0 — their q2=+inf already voids the result."""
     from raft_tpu.kernels.ivf_scan import _QM_GROUP, ivf_scan_query_major
 
     q, _ = queries.shape
@@ -1278,10 +1282,12 @@ def _search_query_major_pallas(
         probes = jnp.pad(probes, ((0, pad), (0, 0)))
         q_rot = jnp.pad(q_rot, ((0, pad), (0, 0)))
         q2 = jnp.pad(q2, (0, pad), constant_values=jnp.inf)
+        if query_fid is not None:
+            query_fid = jnp.pad(query_fid, (0, pad))
     v, i = ivf_scan_query_major(
         probes, q_rot, q2, list_data, list_y2, list_index, int(k),
         metric=metric, scan_dtype=scan_dtype, list_filter=list_filter,
-        scan_scale=scan_scale, interpret=interpret,
+        scan_scale=scan_scale, query_fid=query_fid, interpret=interpret,
     )
     v, i = v[:q], i[:q]
     if metric == "inner_product":
@@ -1399,19 +1405,44 @@ def search(
         return run_query_tiled(run_pm, queries, q_tile)
     from raft_tpu.kernels import ivf_scan as _scan_mod
 
+    has_descriptor = per_row and getattr(sample_filter, "table", None) is not None
     if (
         pallas_scan_enabled(canonical, index.list_data.dtype, allow_int8=True)
         and params.internal_distance_dtype == "float32"
-        # per-row filters ride the XLA query-major leg here: ivf_pq's
-        # fused wrapper has no descriptor plumbing yet (ivf_flat's does —
-        # extend it there first, the rotation makes this one hairier)
-        and not per_row
+        # per-row filters stay fused when they carry the packed
+        # descriptor (RowFilter.from_table); ad-hoc [q, w] word planes
+        # still ride the XLA fallback below
+        and (not per_row or has_descriptor)
         # the fused kernel's per-block score scratch must fit VMEM
         # comfortably; past that the XLA leg tiles better
         and _scan_mod.qm_scratch_bytes(n_probes, index.list_cap)
         <= _scan_mod.QM_VMEM_BUDGET
     ):
         from raft_tpu.kernels import interpret_mode
+
+        if has_descriptor:
+            # ragged descriptor leg: pack every registered filter's
+            # per-list word table once; each query's fid prefetches its
+            # own block (same leg ivf_flat rides — the rotation only
+            # changes the query operand, not the filter plumbing)
+            lf = _scan_mod.pack_list_filter_table(
+                index.list_index, sample_filter.table
+            )
+            fid = jnp.asarray(sample_filter.fid, jnp.int32)
+            _stamp_kernel_path("pallas")
+
+            def run_qm(qt, ft):
+                return _search_query_major_pallas(
+                    qt, index.centers, index.rotation, index.list_data,
+                    index.list_y2, index.list_index, lf,
+                    float(index.scan_scale), n_probes, int(k), canonical,
+                    params.lut_dtype, interpret_mode(), query_fid=ft,
+                )
+
+            return run_query_tiled(
+                run_qm, queries, _scan_mod.qm_query_tile(n_probes),
+                extras=(fid,),
+            )
 
         lf = (
             None if fw is None
@@ -1437,9 +1468,8 @@ def search(
         itemsize = 2 if scan_dtype == jnp.bfloat16 else 4
     per_q = n_probes * index.list_cap * (index.rot_dim * itemsize + 12)
     query_tile = int(min(max(queries.shape[0], 1), max(1, res.workspace_rows(per_q, cap=1024))))
-    # per-row filters land here because the fused wrapper has no
-    # descriptor plumbing — stamp the leg distinctly so the perf ledger's
-    # A/B shows how much traffic rides the fallback
+    # per-row filters land here only when the fused descriptor leg was
+    # unavailable — stamp the fallback distinctly for the perf ledger A/B
     _stamp_kernel_path("xla_filter_fallback" if per_row else "xla")
     return _search_jit(
         queries,

@@ -194,12 +194,27 @@ def analyze_callable(fn, *args, time_run: bool = True) -> Optional[CostReport]:
     """
     import jax
 
+    from raft_tpu.ops import cost as ops_cost
+
     try:
-        compiled = jax.jit(fn).lower(*args).compile()
+        with ops_cost.capture() as notes:
+            compiled = jax.jit(fn).lower(*args).compile()
     except Exception as exc:
         _log.debug("cost analysis unavailable: %r", exc)
         return None
     rep = analyze_compiled(compiled)
+    # Mosaic custom-calls are opaque to XLA's cost model on TPU, so a
+    # kernel-dominated executable can report no flops/bytes at all.  The
+    # Pallas wrappers note their analytic CostEstimates at trace time;
+    # use their total ONLY where XLA reported nothing (in interpret mode
+    # XLA sees the lowered kernel body — supplementing there would
+    # double count).
+    noted = ops_cost.noted_total(notes)
+    if noted is not None:
+        if rep.flops is None and noted.flops:
+            rep.flops = float(noted.flops)
+        if rep.bytes_accessed is None and noted.bytes_accessed:
+            rep.bytes_accessed = float(noted.bytes_accessed)
     if time_run:
         try:
             t0 = time.perf_counter()

@@ -1,6 +1,6 @@
 """Dense primitives: linear algebra + matrix ops (ref: raft/{linalg,matrix}/)."""
 
-from raft_tpu.ops import linalg, matrix
+from raft_tpu.ops import cost, linalg, matrix
 from raft_tpu.ops.matrix import select_k
 
-__all__ = ["linalg", "matrix", "select_k"]
+__all__ = ["cost", "linalg", "matrix", "select_k"]

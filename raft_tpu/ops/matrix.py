@@ -186,6 +186,33 @@ def select_k(
             return vals[0], idx[0]
         return vals, idx
 
+    # fused Pallas k-selection (kernels/select_k.py): a VMEM-resident
+    # masked-extraction top-k replaces the sort-based lax.top_k for the
+    # serving shapes — exact match including the lowest-position-wins tie
+    # break, so the routing is invisible to every caller.  Only the "auto"
+    # heuristic routes; an explicit algo= request is honored verbatim.
+    if not is_int and algo == "auto":
+        from raft_tpu import kernels as _kernels
+
+        if _kernels.use_pallas() and _kernels.select_k_enabled():
+            from raft_tpu.kernels import select_k as _sk
+
+            if _sk.select_k_supported(n, k, scores.dtype):
+                ii = input_indices
+                if ii is not None and ii.ndim == 1:
+                    ii = ii[None, :]
+                vals, idx = _sk.select_k_pallas(
+                    scores, k, select_min=select_min, input_indices=ii,
+                    interpret=_kernels.interpret_mode(),
+                )
+                if row_k is not None:
+                    vals, idx = mask_row_k(
+                        vals, idx, row_k, select_min=select_min
+                    )
+                if squeeze:
+                    return vals[0], idx[0]
+                return vals, idx
+
     if is_int:
         # integers can't be safely negated (INT_MIN) or promoted to float
         # (f32 loses exactness above 2^24); use an exact argsort instead
@@ -251,6 +278,26 @@ def select_k_stable(
     n = scores.shape[-1]
     if k > n:
         raise ValueError(f"k={k} larger than row length {n}")
+    # fused Pallas stable selection (kernels/select_k.py, smallest-id tie
+    # key): one routing point covers merge_topk, the cross-shard merge leg
+    # (serve/shard.py _make_local) and the ragged mask_row_k path without
+    # touching any call site — the kernel's full row stays in VMEM instead
+    # of the two-key sort's HBM round-trip.
+    if not jnp.issubdtype(scores.dtype, jnp.integer):
+        from raft_tpu import kernels as _kernels
+
+        if _kernels.use_pallas() and _kernels.select_k_enabled():
+            from raft_tpu.kernels import select_k as _sk
+
+            if _sk.select_k_supported(n, k, scores.dtype):
+                vals, sids = _sk.select_k_pallas(
+                    scores, k, select_min=select_min, stable=True,
+                    input_indices=input_indices,
+                    interpret=_kernels.interpret_mode(),
+                )
+                if squeeze:
+                    return vals[0], sids[0]
+                return vals, sids
     if input_indices is None:
         ids = jnp.broadcast_to(
             jnp.arange(n, dtype=jnp.int32), scores.shape

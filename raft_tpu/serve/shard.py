@@ -389,7 +389,9 @@ class ShardedIndex:
 
         def _select(vg, ig):
             # ONE cross-shard selection; ties resolve to the smallest
-            # global id regardless of shard layout (select_k_stable)
+            # global id regardless of shard layout (select_k_stable —
+            # which routes to the fused kernels/select_k.py VMEM path at
+            # merge widths, replacing the two-key full-row sort in HBM)
             return matrix.select_k_stable(
                 vg.astype(jnp.float32), k,
                 select_min=select_min, input_indices=ig,

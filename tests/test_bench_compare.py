@@ -612,3 +612,52 @@ def test_perf_ledger_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_kernels_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the Pallas-kernel A/B: run ``bench.py kernels``
+    (select_k stable-merge and wide-beam CAGRA XLA-vs-Pallas arms in
+    interpret mode, then serving-path PerfLedger attribution) and gate
+    it with ``bench.py compare`` against the frozen record.  The leg
+    self-asserts bitwise select_k parity, CAGRA recall/distance
+    equivalence, zero post-warmup recompiles in every arm, and a
+    ``kernel_path="pallas"`` hotspot with a measured roofline — here we
+    re-check the emitted line's contract: both speedups above 1.0 (the
+    Pallas arms beat their XLA twins on the benched shapes), the
+    per-arm kernel_path stamps, and the serving record stamping
+    ``kernel_path: pallas: true``."""
+    candidate = str(tmp_path / "kernels_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "kernels"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0
+    assert line["kernel_path"] == {"pallas": True}
+    sk = line["select_k"]
+    assert sk["speedup"] > 1.0 and sk["parity"] == "bitwise"
+    assert sk["xla"]["kernel_path"] == "xla"
+    assert sk["pallas"]["kernel_path"] == "pallas"
+    cg = line["cagra_traverse"]
+    assert cg["speedup"] > 1.0
+    assert cg["xla"]["kernel_path"] == "xla"
+    assert cg["pallas"]["kernel_path"] == "pallas"
+    assert abs(cg["xla"]["recall"] - cg["pallas"]["recall"]) <= 0.02
+    srv = line["serving"]
+    assert srv["backend"] == "cagra" and srv["pallas_hotspot_device_s"] > 0
+    assert 0.0 < srv["roofline_utilization"] <= 1.0
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_kernels_r15.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

@@ -595,3 +595,68 @@ class TestIvfScanQueryMajor:
         i_p_np = np.asarray(i_p)
         assert (i_p_np[i_p_np >= 0] % 2 == 0).all()
         assert (np.asarray(i_x) == i_p_np).mean() >= 0.99
+
+
+class TestIvfPqDescriptorLeg:
+    """PR 13: ivf_pq's fused query-major leg gains the packed per-list
+    filter-word descriptor (the leg ivf_flat already rides) — ragged
+    per-row-filtered traffic must stamp ``kernel_path=pallas``, not
+    ``xla_filter_fallback``, and agree with the XLA fallback."""
+
+    def _setup(self, seed=3, n=3000, d=32, q=24, n_filters=3):
+        from raft_tpu.core.bitset import RowFilter
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        queries = rng.normal(size=(q, d)).astype(np.float32)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=3), x
+        )
+        n_words = (n + 31) // 32
+        table = np.zeros((n_filters, n_words), np.uint32)
+        for f in range(n_filters):
+            bits = rng.random(n) < 0.6
+            packed = np.packbits(bits, bitorder="little")
+            packed = np.pad(packed, (0, 4 * n_words - packed.size))
+            table[f] = packed.view(np.uint32)
+        fid = rng.integers(0, n_filters, size=q).astype(np.int32)
+        filt = RowFilter.from_table(table, fid, n)
+        return index, queries, table, fid, filt
+
+    def test_descriptor_traffic_stays_pallas(self, monkeypatch):
+        from raft_tpu import kernels
+        from raft_tpu.neighbors import ivf_pq
+
+        index, queries, table, fid, filt = self._setup()
+        sp = ivf_pq.SearchParams(n_probes=16, strategy="query_major")
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "0")
+        v_x, i_x = ivf_pq.search(sp, index, queries, 10, sample_filter=filt)
+        assert kernels.consume_kernel_path() == "xla_filter_fallback"
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        v_p, i_p = ivf_pq.search(sp, index, queries, 10, sample_filter=filt)
+        assert kernels.consume_kernel_path() == "pallas"
+        i_p_np = np.asarray(i_p)
+        np.testing.assert_array_equal(np.asarray(i_x), i_p_np)
+        np.testing.assert_allclose(
+            np.asarray(v_x), np.asarray(v_p), rtol=2e-3, atol=1e-3
+        )
+        # every surfaced id passes its own row's filter
+        for r in range(len(i_p_np)):
+            for c in i_p_np[r]:
+                if c >= 0:
+                    assert (table[fid[r], c // 32] >> (c % 32)) & 1, (r, c)
+
+    def test_plain_word_plane_still_falls_back(self, monkeypatch):
+        # an ad-hoc per-row filter (no registered table) has no
+        # descriptor: it must keep the fallback stamp, fused gate on
+        from raft_tpu import kernels
+        from raft_tpu.core.bitset import RowFilter
+        from raft_tpu.neighbors import ivf_pq
+
+        index, queries, table, fid, _ = self._setup()
+        plain = RowFilter(jnp.asarray(table)[jnp.asarray(fid)], index.size)
+        sp = ivf_pq.SearchParams(n_probes=16, strategy="query_major")
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+        ivf_pq.search(sp, index, queries, 10, sample_filter=plain)
+        assert kernels.consume_kernel_path() == "xla_filter_fallback"

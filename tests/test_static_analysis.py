@@ -80,6 +80,10 @@ def test_discovery_is_not_vacuous(clean_result):
     assert stats["traced_serve_entries_checked"] == 17, stats
     assert stats["traced_batcher_classes"] == 1, stats
     assert stats["recompile_descriptor_entries"] == 4, stats
+    # kernel dispatch attribution: every routed leg stamps from the
+    # closed vocabulary, every pallas_call carries a cost estimate
+    assert stats["traced_kernel_path_stamps"] >= 13, stats
+    assert stats["traced_pallas_cost_estimates"] == 7, stats
 
 
 # -- every rule fires on the seeded fixture ---------------------------------
