@@ -37,7 +37,7 @@ from raft_tpu.analysis.model import ModuleInfo, Project, dotted
 ENTRY_NAMES = {
     "build", "build_batch", "search", "extend",
     "knn", "knn_query", "all_knn_query", "eps_nn",
-    "fit", "predict", "fit_predict", "transform",
+    "fit", "fit_sharded", "predict", "fit_predict", "transform",
     "save", "load", "serialize_to_hnswlib",
 }
 
@@ -58,6 +58,8 @@ SERVE_ENTRY_POINTS = {
     ("serve.compactor.Compactor", "compact"): "serve.compact",
     ("serve.compactor.Compactor", "promote"): "serve.compact.promote",
     ("serve.compactor.Compactor", "abort"): "serve.compact.abort",
+    ("serve.compactor.Compactor", "rebuild_sharded"):
+        "serve.compact.rebuild_sharded",
     ("obs.slo.SloEngine", "evaluate_once"): "slo.evaluate",
     ("obs.incidents.IncidentManager", "handle_event"): "incidents.ingest",
     ("serve.overload.AdmissionController", "decide"):
@@ -66,6 +68,13 @@ SERVE_ENTRY_POINTS = {
     ("serve.overload.HedgedDispatcher", "dispatch"): "serve.hedge.dispatch",
     ("obs.perf.PerfLedger", "record"): "perf.record",
     ("obs.perf.PerfLedger", "evaluate"): "perf.evaluate",
+}
+
+#: module-level (function) serve entry points and their span labels —
+#: the distributed build surface lives on functions, not classes
+SERVE_FUNCTION_ENTRY_POINTS = {
+    ("serve.build", "build_sharded"): "serve.build",
+    ("serve.build", "knn_graph_sharded"): "serve.build.knn_graph",
 }
 
 #: the closed ``kernel_path`` vocabulary (tabulated in docs/kernels.md) —
@@ -213,6 +222,35 @@ def _check_serve_labels(project: Project, result) -> None:
                     "TRACED", cls.module,
                     fn.node if fn is not None else cls.node,
                     f"{cls.qualname}.{meth}",
+                    f"serve entry point {what}, expected "
+                    f"@traced({label!r})",
+                    suppressed_sink=result.suppressed,
+                )
+            if f is not None:
+                result.findings.append(f)
+    for (mod_suffix, fn_name), label in sorted(
+        SERVE_FUNCTION_ENTRY_POINTS.items()
+    ):
+        for mod in project.modules_matching(mod_suffix):
+            checked += 1
+            fn = project.functions.get(f"{mod.name}.{fn_name}")
+            if fn is None or fn.class_name is not None:
+                f = project.finding(
+                    "TRACED", mod, mod.tree, f"{mod.name}.{fn_name}",
+                    f"serve entry point {fn_name} is missing from "
+                    f"{mod.name} (the online span contract lists it)",
+                    suppressed_sink=result.suppressed,
+                )
+            else:
+                got = _traced_label(mod, fn.node)
+                if got == label:
+                    continue
+                what = (
+                    "lacks @traced" if got is _UNTRACED
+                    else f"carries span label {got!r}"
+                )
+                f = project.finding(
+                    "TRACED", mod, fn.node, f"{mod.name}.{fn_name}",
                     f"serve entry point {what}, expected "
                     f"@traced({label!r})",
                     suppressed_sink=result.suppressed,

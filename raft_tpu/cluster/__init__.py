@@ -4,6 +4,7 @@
 from raft_tpu.cluster.kmeans import (
     KMeansParams,
     fit,
+    fit_sharded,
     predict,
     fit_predict,
     transform,
@@ -23,6 +24,7 @@ __all__ = [
     "single_linkage",
     "KMeansParams",
     "fit",
+    "fit_sharded",
     "predict",
     "fit_predict",
     "transform",

@@ -212,6 +212,176 @@ def fit(
     return best
 
 
+@functools.lru_cache(maxsize=32)
+def _lloyd_sharded_program(
+    mesh, axis: str, max_iter: int, tol: float, metric: str, tile: int,
+    reduce_dtype: str,
+):
+    """Build (and cache) the compiled sharded Lloyd loop per (mesh, axis,
+    statics) — a fresh shard_map closure per fit would defeat jit's trace
+    cache and re-trace the while_loop every call."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.core.compat import shard_map
+    from raft_tpu.comms.quantized import quantized_psum
+
+    def local(x, w, c0):
+        x = x.astype(jnp.float32)
+        if metric == "cosine":
+            x = _normalize_rows(x)
+        w = w.astype(jnp.float32)
+        n_clusters, d = c0.shape
+        spherical = metric == "cosine"
+
+        def cond(carry):
+            _, it, prev, cur = carry
+            return (it < max_iter) & ~(
+                jnp.abs(prev - cur) <= tol * jnp.maximum(cur, 1e-30)
+            )
+
+        def body(carry):
+            centers, it, _, prev_inertia = carry
+            best, labels = _assign(x, centers, tile)
+            local_inertia = jnp.sum(w * best)
+            sums = jax.ops.segment_sum(
+                x * w[:, None], labels, num_segments=n_clusters
+            )
+            counts = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
+            # ONE collective per iteration: the [k, d] partial sums, the
+            # counts column, and the inertia scalar ride a single packed
+            # (optionally quantized) psum — the build loop's only
+            # cross-device traffic
+            side = jnp.zeros((n_clusters, 2), jnp.float32)
+            side = side.at[:, 0].set(counts).at[0, 1].set(local_inertia)
+            packed = quantized_psum(
+                jnp.concatenate([sums, side], axis=1), axis, reduce_dtype
+            )
+            g_sums, g_counts = packed[:, :d], packed[:, d]
+            inertia = packed[0, d + 1]
+            centers = jnp.where(
+                g_counts[:, None] > 0,
+                g_sums / jnp.maximum(g_counts[:, None], 1e-30),
+                centers,
+            )
+            if spherical:
+                centers = _normalize_rows(centers)
+            return centers, it + 1, prev_inertia, inertia
+
+        centers, n_iter, _, _ = lax.while_loop(
+            cond, body, (c0, jnp.int32(0), jnp.inf, jnp.inf)
+        )
+        # final inertia measured against the final centers (matches _lloyd)
+        best, _ = _assign(x, centers, tile)
+        inertia = lax.psum(jnp.sum(w * best), axis)
+        return centers, inertia, n_iter
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P(None, None)),
+            out_specs=(P(None, None), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+@traced("kmeans.fit_sharded")
+def fit_sharded(
+    comms,
+    params: KMeansParams,
+    data_sharded: jax.Array,
+    sample_weights: Optional[jax.Array] = None,
+    *,
+    init_centers: Optional[jax.Array] = None,
+    reduce_dtype: Optional[str] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`fit` over data row-sharded across ``comms``' mesh axis.
+
+    Semantically :func:`fit`'s Lloyd loop, distributed: each shard
+    assigns its rows and computes partial centroid sums/counts; the
+    partials merge in ONE packed ``psum`` per iteration (optionally
+    bf16/int8-quantized via ``reduce_dtype`` /
+    ``RAFT_TPU_BUILD_REDUCE_DTYPE``).  The training rows never funnel
+    through one host — only [k, d+2] statistics travel.
+
+    ``data_sharded`` is the global [n, d] array (sharded or shardable on
+    the comms axis; n must divide the axis size — pad with zero-weight
+    rows otherwise).  ``sample_weights`` shards alongside the rows.
+    Init is on a replicated weight-aware subsample (rows travel once);
+    ``init_centers`` bypasses it, giving runs that are comparable
+    1:1 against a single-host :func:`fit` with the same init.
+
+    Returns replicated (centroids, inertia, n_iter) like :func:`fit`.
+    """
+    res = ensure(res)
+    if params.metric not in ("sqeuclidean", "euclidean", "l2", "cosine"):
+        raise ValueError(
+            f"kmeans supports sqeuclidean/cosine, got {params.metric}"
+        )
+    metric = "cosine" if params.metric == "cosine" else "sqeuclidean"
+    n, _ = data_sharded.shape
+    size = comms.get_size()
+    if n % size != 0:
+        raise ValueError(
+            f"n={n} rows do not divide the {size}-way mesh axis; pad the "
+            "shard with zero-weight rows (serve.build does this)"
+        )
+    if reduce_dtype is None:
+        from raft_tpu.comms.quantized import reduce_dtype_from_env
+
+        reduce_dtype = reduce_dtype_from_env()
+    w = (
+        jnp.ones((n,), jnp.float32)
+        if sample_weights is None
+        else jnp.asarray(sample_weights, jnp.float32)
+    )
+    key = jax.random.fold_in(jax.random.PRNGKey(params.seed), 0)
+    if params.init == "array" and init_centers is None:
+        raise ValueError("init='array' requires init_centers")
+
+    run = _lloyd_sharded_program(
+        comms.mesh, comms.axis, params.max_iter, float(params.tol), metric,
+        params.batch_samples, reduce_dtype,
+    )
+
+    subsample = w_sub = None
+    if init_centers is None:
+        # replicated init subsample: rows travel once at init.  A
+        # with-replacement draw is O(n_sub) — no full-n permutation of
+        # the sharded dataset; collisions in an init sample are harmless
+        k_sub, key = jax.random.split(key)
+        n_sub = min(n, max(4 * params.n_clusters, 4096))
+        idx = jax.random.randint(k_sub, (n_sub,), 0, n)
+        subsample = jnp.asarray(data_sharded[idx], jnp.float32)
+        if metric == "cosine":
+            subsample = _normalize_rows(subsample)
+        w_sub = w[idx]  # zero-weight padding rows are never seeds
+
+    n_init = 1 if init_centers is not None else max(params.n_init, 1)
+    best = None
+    for trial in range(n_init):
+        kt = jax.random.fold_in(key, trial)
+        if init_centers is not None:
+            c0 = jnp.asarray(init_centers, jnp.float32)
+            if metric == "cosine":
+                c0 = _normalize_rows(c0)
+        elif params.init == "random":
+            idx2 = jax.random.choice(
+                kt, subsample.shape[0], shape=(params.n_clusters,),
+                replace=subsample.shape[0] < params.n_clusters,
+                p=w_sub / jnp.maximum(jnp.sum(w_sub), 1e-12),
+            )
+            c0 = subsample[idx2]
+        else:
+            c0 = kmeans_plus_plus_init(kt, subsample, params.n_clusters, w_sub)
+        centers, inertia, n_iter = run(data_sharded, w, c0)
+        if best is None or float(inertia) < float(best[1]):
+            best = (centers, inertia, n_iter)
+    return best
+
+
 @traced("kmeans.predict")
 def predict(
     centroids: jax.Array,

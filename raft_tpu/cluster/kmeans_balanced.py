@@ -286,6 +286,167 @@ def fit(
     return centers
 
 
+@functools.lru_cache(maxsize=32)
+def _balanced_sharded_program(
+    mesh, axis: str, n_iters: int, n_clusters: int, metric: str,
+    tile_rows: int, reduce_dtype: str,
+):
+    """Build (and cache) the compiled sharded balancing loop — the
+    distributed counterpart of :func:`_balanced_iterations`.  Each shard
+    assigns its rows and computes partial sums/counts; partials merge in
+    ONE packed (optionally quantized) psum per iteration.  The starved-
+    cluster teleport draws from a replicated weight-mass pool (the init
+    subsample) instead of the full trainset — the draw must be identical
+    on every shard, and shipping a cross-shard gather into the scan would
+    reintroduce per-iteration row traffic."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.core.compat import shard_map
+    from raft_tpu.comms.quantized import quantized_psum
+
+    spherical = metric == "cosine"
+    inner = "inner_product" if metric == "inner_product" else "sqeuclidean"
+
+    def local(key, x, w, c0, pool, pool_w):
+        x = _maybe_normalize(x.astype(jnp.float32), metric)
+        w = w.astype(jnp.float32)
+        d = c0.shape[1]
+        m = pool.shape[0]
+
+        def assign(centers):
+            return tiled_argmin(x, centers, inner, tile_rows)
+
+        def update(centers):
+            labels = assign(centers)
+            sums = jax.ops.segment_sum(
+                x * w[:, None], labels, num_segments=n_clusters
+            )
+            counts = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
+            packed = quantized_psum(
+                jnp.concatenate([sums, counts[:, None]], axis=1),
+                axis, reduce_dtype,
+            )
+            g_sums, g_counts = packed[:, :d], packed[:, d]
+            centers = jnp.where(
+                g_counts[:, None] > 0,
+                g_sums / jnp.maximum(g_counts[:, None], 1e-30),
+                centers,
+            )
+            if spherical:
+                centers = _maybe_normalize(centers, "cosine")
+            return centers, labels, g_counts
+
+        def body(carry, key_i):
+            centers, _, g_counts = update(carry)
+            # teleport starved clusters onto random pool rows (same
+            # inverse-CDF weight-mass draw as _balanced_iterations);
+            # replicated pool + replicated key → every shard teleports
+            # identically, keeping centers replicated without a collective
+            avg = jnp.sum(g_counts) / n_clusters
+            starved = g_counts < avg / 8.0
+            cum = jnp.cumsum((pool_w > 0).astype(jnp.int32))
+            r = jax.random.randint(key_i, (n_clusters,), 1, cum[-1] + 1)
+            picks = jnp.clip(jnp.searchsorted(cum, r), 0, m - 1)
+            centers = jnp.where(starved[:, None], pool[picks], centers)
+            return centers, g_counts
+
+        keys = jax.random.split(key, n_iters)
+        centers, _ = lax.scan(body, c0, keys)
+        # final clean update without adjustment
+        centers, labels, _ = update(centers)
+        return centers, labels
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                P(None), P(axis, None), P(axis), P(None, None),
+                P(None, None), P(None),
+            ),
+            out_specs=(P(None, None), P(axis)),
+            check_vma=False,
+        )
+    )
+
+
+@traced("kmeans_balanced.fit_sharded")
+def fit_sharded(
+    comms,
+    params: KMeansBalancedParams,
+    data_sharded: jax.Array,
+    n_clusters: int,
+    sample_weights: Optional[jax.Array] = None,
+    *,
+    init_centers: Optional[jax.Array] = None,
+    reduce_dtype: Optional[str] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`fit` over data row-sharded across ``comms``' mesh axis.
+
+    Seeding (the hierarchical/flat :func:`fit`) runs on a replicated
+    weight-aware subsample — rows travel exactly once, bounded size —
+    then the balancing iterations run distributed over the FULL sharded
+    trainset: per-shard assign + partial sums, merged with one packed
+    (optionally ``reduce_dtype``-quantized, env
+    ``RAFT_TPU_BUILD_REDUCE_DTYPE``) psum per iteration.  The starved-
+    cluster teleport draws from the replicated subsample (a weight-mass
+    draw like the reference's adjust_centers) so all shards stay
+    center-replicated without extra collectives.
+
+    ``data_sharded`` is [n, d] with n a multiple of the axis size (pad
+    with zero-weight rows otherwise).  Returns (centers [k, d]
+    replicated, labels [n] sharded).
+    """
+    res = ensure(res)
+    metric = params.metric
+    n, _ = data_sharded.shape
+    size = comms.get_size()
+    if n % size != 0:
+        raise ValueError(
+            f"n={n} rows do not divide the {size}-way mesh axis; pad the "
+            "shard with zero-weight rows (serve.build does this)"
+        )
+    if reduce_dtype is None:
+        from raft_tpu.comms.quantized import reduce_dtype_from_env
+
+        reduce_dtype = reduce_dtype_from_env()
+    w = (
+        jnp.ones((n,), jnp.float32)
+        if sample_weights is None
+        else jnp.asarray(sample_weights, jnp.float32)
+    )
+    key = jax.random.PRNGKey(params.seed)
+    k_sub, k_iter = jax.random.split(key)
+
+    # replicated pool: seeds the hierarchy AND feeds the teleport draws.
+    # With-replacement draw — O(n_sub), no full-n permutation; host-side
+    # filtering drops zero-weight padding rows so they never seed
+    n_sub = min(n, max(8 * n_clusters, 8192))
+    idx = np.asarray(
+        jax.random.randint(k_sub, (n_sub,), 0, n), dtype=np.int64
+    )
+    w_np = np.asarray(w)
+    idx = idx[w_np[idx] > 0]
+    if idx.size == 0:
+        raise ValueError("all sample weights are zero; nothing to cluster")
+    pool = _maybe_normalize(
+        jnp.asarray(data_sharded[jnp.asarray(idx)], jnp.float32), metric
+    )
+    pool_w = jnp.asarray(w_np[idx])
+
+    if init_centers is None:
+        c0 = fit(params, pool, n_clusters, res=res)
+    else:
+        c0 = _maybe_normalize(jnp.asarray(init_centers, jnp.float32), metric)
+
+    run = _balanced_sharded_program(
+        comms.mesh, comms.axis, max(1, params.n_iters), n_clusters, metric,
+        argmin_tile_rows(n_clusters, res), reduce_dtype,
+    )
+    return run(k_iter, data_sharded, w, c0, pool, pool_w)
+
+
 @traced("kmeans_balanced.fit_predict")
 def fit_predict(
     params: KMeansBalancedParams,

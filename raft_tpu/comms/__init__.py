@@ -28,6 +28,10 @@ from raft_tpu.comms.comms import (
     perform_test_comms_send_recv,
     perform_test_comm_split,
 )
+from raft_tpu.comms.quantized import (
+    quantized_psum,
+    reduce_dtype_from_env,
+)
 from raft_tpu.comms.bootstrap import (
     CommsCluster,
     initialize,
@@ -44,6 +48,8 @@ __all__ = [
     "Comms",
     "make_mesh",
     "local_comms",
+    "quantized_psum",
+    "reduce_dtype_from_env",
     "CommsCluster",
     "initialize",
     "shutdown",

@@ -121,6 +121,13 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_COMPACT_RECALL_SLACK", "float", "0.02",
            "gate tolerance: shadow recall may trail serving recall by at "
            "most this"),
+    # -- distributed build ---------------------------------------------------
+    EnvVar("RAFT_TPU_BUILD_REDUCE_DTYPE", "str", "float32",
+           "bfloat16/int8 quantizes the per-iteration centroid/codebook "
+           "psum of the sharded index build (EQuARX-style)"),
+    EnvVar("RAFT_TPU_BUILD_KNN_BLOCK_ROWS", "int", "unset",
+           "row-block size of the ring kNN exchange in the sharded "
+           "CAGRA graph build (default: one shard's rows per step)"),
     # -- observability -------------------------------------------------------
     EnvVar("RAFT_TPU_OBS_DISABLED", "bool", "unset",
            "1 disables span recording entirely (metrics stay on)"),

@@ -134,7 +134,7 @@ class Index:
 
 def _pack_lists(
     dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray, n_lists: int,
-    metric: str, headroom: bool = True,
+    metric: str, headroom: bool = True, max_cap="default",
 ):
     """Streamed pack into the padded [n_lists', cap, dim] device layout +
     per-slot norms: (list, slot) metadata host-side
@@ -148,9 +148,11 @@ def _pack_lists(
     expands its centroid rows."""
     n = dataset.shape[0]
     d = dataset.shape[1]
+    # max_cap=None disables skew splitting — the sharded build's
+    # shard-major relabel needs list ids to stay stable (serve.build)
     lst, slot, sizes, center_map, cap = compute_list_layout(
         labels, n_lists,
-        max_cap=default_max_cap(n, n_lists),
+        max_cap=default_max_cap(n, n_lists) if max_cap == "default" else max_cap,
         headroom=headroom,
     )
     L = len(center_map)

@@ -568,6 +568,7 @@ def _assemble_lists(
     centers_rot: np.ndarray,
     dtype,
     headroom: bool = True,
+    max_cap="default",
 ):
     """Streamed device-side list assembly: compute the (list, slot) layout
     host-side (metadata only — O(n) ints, no padded payload copies), then
@@ -582,9 +583,11 @@ def _assemble_lists(
     _common.split_oversized_lists); returns center_map for the caller to
     expand centers/codebooks."""
     n, pq_dim = codes.shape
+    # max_cap=None disables skew splitting — the sharded build's
+    # shard-major relabel needs list ids to stay stable (serve.build)
     lst, slot, sizes, center_map, cap = compute_list_layout(
         labels, n_lists,
-        max_cap=default_max_cap(n, n_lists),
+        max_cap=default_max_cap(n, n_lists) if max_cap == "default" else max_cap,
         headroom=headroom,
     )
     L = len(center_map)

@@ -70,6 +70,7 @@ KINDS = frozenset({
     "degraded_exit",
     "hedge_fired",
     "perf_regression",
+    "build_complete",
 })
 
 #: kinds that open incidents / trigger flight dumps; the rest are context

@@ -48,6 +48,7 @@ books XLA cost/memory figures per bucket into the registry.  See
 """
 
 from raft_tpu.serve.batcher import MicroBatcher
+from raft_tpu.serve.build import build_sharded, knn_graph_sharded
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
 from raft_tpu.serve.metrics import (
     ServingMetrics,
@@ -92,7 +93,9 @@ __all__ = [
     "ServingMetrics",
     "Shed",
     "ShardedIndex",
+    "build_sharded",
     "compile_count",
+    "knn_graph_sharded",
     "install_compile_listener",
     "make_replicated_search",
     "replicated_search",

@@ -736,6 +736,91 @@ class Compactor:
             mi._retired_to = shadow
         return version
 
+    @traced("serve.compact.rebuild_sharded")
+    def rebuild_sharded(
+        self, name: str, comms=None, *, n_devices: Optional[int] = None,
+        index_params=None, search_params=None,
+        reduce_dtype: Optional[str] = None,
+    ) -> Dict[str, object]:
+        """Distributed full rebuild: retrain ``name``'s live rows into a
+        fresh :class:`~raft_tpu.serve.shard.ShardedIndex` over the mesh
+        (:func:`raft_tpu.serve.build.build_sharded`) and hot-swap it in.
+
+        This is the capacity escape hatch the in-place compaction pass
+        cannot offer: when the live set has outgrown a single-chip shadow
+        rebuild, the training runs sharded (every Lloyd/codebook/kNN leg
+        on the mesh) and the result lands already partitioned.  The
+        served id space becomes dense row positions ``0..m-1`` (same
+        contract as ``ShardedIndex.from_index`` after a compaction); the
+        returned ``ids`` array maps new position → old global id.
+        ``index_params`` defaults to the source's metric and (for IVF
+        kinds) its current ``n_lists``.
+        """
+        from raft_tpu.serve.build import build_sharded
+
+        mi = self.service.registry.get(name)
+        if not isinstance(mi, MutableIndex):
+            return {
+                "name": name, "status": "noop",
+                "reason": f"not mutable ({type(mi).__name__})",
+            }
+        with self._pass_lock:
+            with mi._lock:
+                cap = _capture_locked(mi)
+            live_main = int((~cap.deleted).sum())
+            side_live_n = int(cap.side_live[: cap.side_count].sum())
+            m = live_main + side_live_n
+            if m < 2:
+                return self.abort(name, "empty", f"only {m} live rows")
+            rows, gids = self._gather_live(mi, cap, m)
+            if index_params is None:
+                index_params = self._default_build_params(mi)
+            if search_params is None:
+                search_params = mi.search_params
+            sharded = build_sharded(
+                mi.kind, rows, comms, n_devices=n_devices,
+                index_params=index_params, search_params=search_params,
+                metric=mi.metric, reduce_dtype=reduce_dtype, label=name,
+            )
+            with mi._lock:
+                version = self.service.registry.swap(name, sharded)
+                # retire the writer: contains() keeps answering through
+                # the successor, while forwarded upsert/delete hit
+                # ShardedIndex's loud NotImplementedError instead of
+                # silently landing on a dead index
+                mi._retired_to = sharded
+        obs_events.publish(
+            "registry_swap", index=name, version=version,
+            reason="sharded rebuild",
+        )
+        return {
+            "name": name, "status": "promoted", "rows": m,
+            "shards": sharded.n_shards, "version": version, "ids": gids,
+        }
+
+    def _default_build_params(self, mi: MutableIndex):
+        """Backend IndexParams mirroring the source's metric/list count."""
+        if mi.kind == "brute_force":
+            return None
+        if mi.kind == "ivf_flat":
+            from raft_tpu.neighbors import ivf_flat
+
+            return ivf_flat.IndexParams(
+                n_lists=int(mi.index.n_lists), metric=mi.metric,
+            )
+        if mi.kind == "ivf_pq":
+            from raft_tpu.neighbors import ivf_pq
+
+            old = mi.index
+            return ivf_pq.IndexParams(
+                n_lists=int(old.n_lists), metric=mi.metric,
+                pq_bits=int(old.pq_bits), pq_dim=int(old.pq_dim),
+                codebook_kind=old.codebook_kind,
+            )
+        from raft_tpu.neighbors import cagra
+
+        return cagra.IndexParams(metric=mi.metric)
+
     @traced("serve.compact.abort")
     def abort(self, name: str, reason: str, detail: str = "") -> Dict[str, object]:
         """Record a failed/refused pass: log, gauge, cooldown, re-arm."""

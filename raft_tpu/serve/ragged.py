@@ -221,19 +221,21 @@ class RaggedSearcher:
                 self._filters.n_bits, pass_count=min_pass,
             )
         if not isinstance(index, MutableIndex):
-            # ShardedIndex (and anything else duck-typed): no per-row
-            # filter leg in the cross-shard merge — run at k_max and
-            # mask each row's k after it
-            if sample_filter is not None:
-                raise NotImplementedError(
-                    "ragged filters are not supported for "
-                    f"{type(index).__name__}; serve it with "
-                    "RaggedSpec(filters=False)"
-                )
+            # ShardedIndex (and anything else duck-typed): run at k_max
+            # and mask each row's k after it.  Registered filters ride as
+            # a per-query global-id RowFilter — the packed table is tiny
+            # and replicates to every shard (ShardedIndex.search rebases
+            # it per shard; one extra cached executable per k, never a
+            # per-(k × filter) lattice)
             # perf-ledger attribution: the SPMD body traces once, so the
             # routing stamp happens here on the host, not inside search
             _kernels.stamp_kernel_path("sharded")
-            dist, ids = index.search(queries, self._spec.k_max)
+            if sample_filter is not None:
+                dist, ids = index.search(
+                    queries, self._spec.k_max, sample_filter=sample_filter
+                )
+            else:
+                dist, ids = index.search(queries, self._spec.k_max)
             select_min = DISTANCE_TYPES[index.metric] != "inner_product"
             return mask_row_k(dist, ids, row_k, select_min=select_min)
         search_params = None

@@ -227,6 +227,11 @@ class SearchService:
                     # rows upserted later get ids past this range and pass
                     # every filter (uncovered = unconstrained).
                     freg = FilterRegistry(max(1, index.main_size))
+                elif self.ragged.filters and isinstance(index, ShardedIndex):
+                    # sharded layouts carry dense global row ids; the
+                    # packed predicate table replicates to every shard and
+                    # ShardedIndex.search rebases it per shard
+                    freg = FilterRegistry(max(1, index.size))
                 self._filter_regs[name] = freg
                 search_fn = RaggedSearcher(
                     self, name, self.ragged, freg, degraded=degraded
@@ -367,8 +372,8 @@ class SearchService:
             freg = self._filter_regs.get(name)
         if freg is None:
             raise RuntimeError(
-                f"no filter registry for {name!r}: the index is not "
-                "filterable (ShardedIndex) or the spec has filters=False"
+                f"no filter registry for {name!r}: the index kind is not "
+                "filterable or the spec has filters=False"
             )
         return freg.register(mask)
 

@@ -54,7 +54,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raft_tpu import obs
 from raft_tpu.comms.comms import Comms, local_comms
 from raft_tpu.core import env as _env
-from raft_tpu.core.bitset import Bitset, WORD_BITS
+from raft_tpu.core.bitset import Bitset, RowFilter, WORD_BITS
 from raft_tpu.core.compat import shard_map
 from raft_tpu.core.trace import trace_range
 from raft_tpu.distance.pairwise import DISTANCE_TYPES
@@ -306,23 +306,44 @@ class ShardedIndex:
         return self
 
     # -- search --------------------------------------------------------------
-    def search(self, queries, k: int) -> Tuple[jax.Array, jax.Array]:
+    def search(
+        self, queries, k: int, *, sample_filter=None
+    ) -> Tuple[jax.Array, jax.Array]:
         """Global (distances [q, k], ids [q, k]) over all shards.
 
         One SPMD dispatch: per-shard local search + the single cross-shard
         merge collective.  Executables are cached per k (and per query
         batch shape via jit), preserving the batcher's zero-recompile
         contract once the bucket ladder is warm.
+
+        ``sample_filter`` is an optional per-query
+        :class:`~raft_tpu.core.bitset.RowFilter` over **global** ids (the
+        ragged path's packed predicate words, replicated to every shard):
+        the IVF legs pass it straight into the local search (their
+        ``list_index`` ids are global), the row-partitioned legs re-base
+        the global bits onto each shard's local rows.  The filtered
+        executable is cached separately — serving a filter-free stream
+        never pays the gather.
         """
         queries = jnp.asarray(queries, jnp.float32)
         if queries.ndim != 2 or queries.shape[1] != self.dim:
             raise ValueError(
                 f"queries shape {queries.shape} vs index dim {self.dim}"
             )
-        f = self._searcher(int(k))
+        fargs = ()
+        filter_bits = None
+        if sample_filter is not None:
+            if not isinstance(sample_filter, RowFilter):
+                raise TypeError(
+                    "ShardedIndex.search expects a per-query RowFilter "
+                    f"over global ids, got {type(sample_filter).__name__}"
+                )
+            filter_bits = int(sample_filter.n_bits)
+            fargs = (jnp.asarray(sample_filter.words, jnp.uint32),)
+        f = self._searcher(int(k), filter_bits)
         t0 = time.perf_counter()
         with trace_range("serve.sharded_search") as sp:
-            v, i = f(queries, *(self._parts[n] for n in self._names))
+            v, i = f(queries, *fargs, *(self._parts[n] for n in self._names))
             dt = time.perf_counter() - t0
             if sp is not None:
                 # dispatch: tracing/enqueue of the sharded executable (the
@@ -339,11 +360,12 @@ class ShardedIndex:
     def n_shards(self) -> int:
         return self.comms.get_size()
 
-    def _searcher(self, k: int):
-        f = self._searchers.get(k)
+    def _searcher(self, k: int, filter_bits: Optional[int] = None):
+        key = (k, filter_bits)
+        f = self._searchers.get(key)
         if f is None:
-            f = self._build_searcher(k)
-            self._searchers[k] = f
+            f = self._build_searcher(k, filter_bits)
+            self._searchers[key] = f
         return f
 
     def _local_pool(self) -> Tuple[int, int]:
@@ -355,7 +377,7 @@ class ShardedIndex:
         npb = min(int(self.search_params.n_probes), l_local)
         return npb, npb * cap
 
-    def _build_searcher(self, k: int):
+    def _build_searcher(self, k: int, filter_bits: Optional[int] = None):
         mesh, axis = self.comms.mesh, self.comms.axis
         npb, pool = self._local_pool()
         kk = min(k, pool)
@@ -364,8 +386,9 @@ class ShardedIndex:
                 f"k={k} exceeds the sharded candidate pool "
                 f"{self.n_shards}x{kk}; raise n_probes or lower k"
             )
-        local = self._make_local(k, kk, npb)
-        in_specs = (P(None, None),) + tuple(
+        local = self._make_local(k, kk, npb, filter_bits)
+        filter_specs = () if filter_bits is None else (P(None, None),)
+        in_specs = (P(None, None),) + filter_specs + tuple(
             self._specs[n] for n in self._names
         )
         return jax.jit(
@@ -378,13 +401,14 @@ class ShardedIndex:
             )
         )
 
-    def _make_local(self, k: int, kk: int, npb: int):
+    def _make_local(self, k: int, kk: int, npb: int,
+                    filter_bits: Optional[int] = None):
         # the per-shard search and the merge selection both run under
         # nested jit, not bare in the shard_map body: older jax's
         # ShardMapTracer lacks the eager operator surface, while
         # nested-jit tracers are complete (same split as replica.py) —
         # only the all-gather collectives live in the bare body
-        core = jax.jit(self._make_shard_search(kk, npb))
+        core = jax.jit(self._make_shard_search(kk, npb, filter_bits))
         select_min = self.select_min
 
         def _select(vg, ig):
@@ -399,21 +423,36 @@ class ShardedIndex:
 
         sel = jax.jit(_select)
 
-        def local(q, *args):
-            v, gi = core(q, *args)
-            vg = self.comms.allgather(v, axis=1)
-            ig = self.comms.allgather(gi, axis=1)
-            return sel(vg, ig)
+        if filter_bits is None:
+            def local(q, *args):
+                v, gi = core(q, *args)
+                vg = self.comms.allgather(v, axis=1)
+                ig = self.comms.allgather(gi, axis=1)
+                return sel(vg, ig)
+        else:
+            def local(q, words, *args):
+                v, gi = core(q, words, *args)
+                vg = self.comms.allgather(v, axis=1)
+                ig = self.comms.allgather(gi, axis=1)
+                return sel(vg, ig)
 
         return local
 
-    def _make_shard_search(self, kk: int, npb: int):
-        """Per-shard ``(queries, *parts) -> (dists [q,kk], global ids)``,
-        squeezing the leading shard axis off every partitioned block and
-        re-assembling the backend Index so the *existing* local search
-        (Pallas scan legs included) runs unchanged over the partition.
-        The optional EQuARX-style bf16 cast of the candidate distances
-        happens here, before the merge all-gather moves them."""
+    def _make_shard_search(self, kk: int, npb: int,
+                           filter_bits: Optional[int] = None):
+        """Per-shard ``(queries[, filter words], *parts) -> (dists [q,kk],
+        global ids)``, squeezing the leading shard axis off every
+        partitioned block and re-assembling the backend Index so the
+        *existing* local search (Pallas scan legs included) runs unchanged
+        over the partition.  The optional EQuARX-style bf16 cast of the
+        candidate distances happens here, before the merge all-gather
+        moves them.
+
+        When ``filter_bits`` is set the core takes the replicated
+        per-query global-id filter words as its second operand: IVF legs
+        AND them with the tombstone bitset and pass the RowFilter through
+        (list ids are global); row legs re-base the global bits onto
+        local row positions before the local knn."""
         names = self._names
         merge_dtype = self.merge_dtype
 
@@ -422,13 +461,40 @@ class ShardedIndex:
                 return v.astype(merge_dtype)
             return v
 
+        def _global_filter(p, words):
+            """Tombstone bitset, per-query RowFilter, or their AND —
+            all over the global id space the IVF list ids live in."""
+            filt = _replicated_filter(p)
+            if words is None:
+                return filt
+            if filt is not None:
+                nw = min(int(filt.words.shape[0]), int(words.shape[1]))
+                words = words.at[:, :nw].set(
+                    words[:, :nw] & filt.words[:nw][None, :]
+                )
+            return RowFilter(words, filter_bits)
+
         if self.kind in ("brute_force", "cagra"):
             from raft_tpu.neighbors import brute_force
 
-            def core(q, *args):
+            def core(q, *args, words=None):
                 p = dict(zip(names, args))
                 rows, ids = p["rows"][0], p["ids"][0]
-                filt = Bitset(p["pass_words"][0], rows.shape[0])
+                if words is None:
+                    filt = Bitset(p["pass_words"][0], rows.shape[0])
+                else:
+                    # re-base the global per-query bits onto this shard's
+                    # local row positions (ids are the global row ids),
+                    # folding the local pass bitset in
+                    safe = jnp.clip(ids, 0, None).astype(jnp.uint32)
+                    w = words[:, safe // WORD_BITS]           # [q, r]
+                    bit = (w >> (safe % WORD_BITS)) & jnp.uint32(1)
+                    mask = (bit == 1) & (ids >= 0)[None, :]
+                    local_words = (
+                        RowFilter.from_mask_rows(mask).words
+                        & p["pass_words"][0][None, :]
+                    )
+                    filt = RowFilter(local_words, rows.shape[0])
                 v, li = brute_force.knn(
                     rows, q, kk, metric=self.metric, sample_filter=filt
                 )
@@ -436,53 +502,72 @@ class ShardedIndex:
                 gi = jnp.where(li >= 0, ids[safe], jnp.int32(-1))
                 return _cast(v), gi
 
-            return core
-
-        if self.kind == "ivf_flat":
+        elif self.kind == "ivf_flat":
             from raft_tpu.neighbors import ivf_flat
 
             sp = dataclasses.replace(self.search_params, n_probes=npb)
 
-            def core(q, *args):
+            def core(q, *args, words=None):
                 p = dict(zip(names, args))
                 sub = ivf_flat.Index(
                     self.metric, p["centers"][0], p["list_data"][0],
                     p["list_index"][0], p["list_sizes"][0], p["list_norms"][0],
                 )
-                filt = _replicated_filter(p)
+                filt = _global_filter(p, words)
                 v, gi = ivf_flat.search(sp, sub, q, kk, sample_filter=filt)
                 return _cast(v), gi
 
+        else:
+            from raft_tpu.neighbors import ivf_pq
+
+            codebook_kind, pq_bits, scan_scale = self._pq_meta
+            sp = dataclasses.replace(self.search_params, n_probes=npb)
+
+            def core(q, *args, words=None):
+                p = dict(zip(names, args))
+                codebook = (
+                    p["codebook"][0] if codebook_kind == "per_cluster"
+                    else p["codebook"]
+                )
+                sub = ivf_pq.Index(
+                    self.metric, codebook_kind, pq_bits, p["centers"][0],
+                    p["centers_rot"][0], p["rotation"], codebook,
+                    p["list_codes"][0], p["list_index"][0], p["list_sizes"][0],
+                    p["list_data"][0], p["list_y2"][0], scan_scale=scan_scale,
+                )
+                filt = _global_filter(p, words)
+                v, gi = ivf_pq.search(sp, sub, q, kk, sample_filter=filt)
+                return _cast(v), gi
+
+        if filter_bits is None:
             return core
 
-        from raft_tpu.neighbors import ivf_pq
+        def filtered(q, words, *args):
+            return core(q, *args, words=words)
 
-        codebook_kind, pq_bits, scan_scale = self._pq_meta
-        sp = dataclasses.replace(self.search_params, n_probes=npb)
-
-        def core(q, *args):
-            p = dict(zip(names, args))
-            codebook = (
-                p["codebook"][0] if codebook_kind == "per_cluster"
-                else p["codebook"]
-            )
-            sub = ivf_pq.Index(
-                self.metric, codebook_kind, pq_bits, p["centers"][0],
-                p["centers_rot"][0], p["rotation"], codebook,
-                p["list_codes"][0], p["list_index"][0], p["list_sizes"][0],
-                p["list_data"][0], p["list_y2"][0], scan_scale=scan_scale,
-            )
-            filt = _replicated_filter(p)
-            v, gi = ivf_pq.search(sp, sub, q, kk, sample_filter=filt)
-            return _cast(v), gi
-
-        return core
+        return filtered
 
     # -- MutableIndex-compatible serving surface ----------------------------
     def pending_mutations(self) -> Tuple[int, int]:
         """(0, 0): a sharded layout is immutable; mutate the source index
         and hot-swap a re-shard through the registry."""
         return 0, 0
+
+    def upsert(self, vectors, ids=None):
+        """Loud failure for writes forwarded after a sharded rebuild
+        (a retired MutableIndex forwards mutations to its successor)."""
+        raise NotImplementedError(
+            "ShardedIndex is immutable: rebuild through "
+            "serve.build.build_sharded (or Compactor.rebuild_sharded) and "
+            "hot-swap the result"
+        )
+
+    def delete(self, ids):
+        raise NotImplementedError(
+            "ShardedIndex is immutable: rebuild through "
+            "serve.build.build_sharded (or Compactor.rebuild_sharded) and "
+            "hot-swap the result"
+        )
 
     def device_bytes(self) -> int:
         """Total bytes across all shards (feeds the per-version live-buffer

@@ -661,3 +661,47 @@ def test_kernels_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_distributed_build_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the distributed-build A/B: run ``bench.py build``
+    (single-host ivf_flat.build vs build_sharded over 8 forced host
+    devices, f32 vs bf16-quantized training collectives) and gate it
+    with ``bench.py compare`` against the frozen record.  The leg
+    self-asserts a >= 4x modeled 8-device speedup and bf16 build-quality
+    parity; here we also pin recall at exhaustive probing and zero
+    recompiles on the warmed build path."""
+    candidate = str(tmp_path / "build_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "build"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["devices"] == 8
+    assert line["speedup_modeled_x"] >= 4.0, (
+        f"modeled 8-device build speedup {line['speedup_modeled_x']}x < 4x"
+    )
+    assert line["recall"] >= 0.999
+    assert line["recompiles"] == 0, "warmed build path recompiled"
+    arms = line["arms"]
+    # the quantized arm halves the per-iteration psum payload and must
+    # not trade away build quality
+    assert arms["sharded_bf16"]["psum_bytes_per_iter"] == (
+        arms["sharded_f32"]["psum_bytes_per_iter"] // 2
+    )
+    assert arms["sharded_bf16"]["recall"] >= arms["single"]["recall"] - 0.02
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_build_r16.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

@@ -1,0 +1,342 @@
+"""Distributed index build (raft_tpu.serve.build): training over the
+forced 8-device host mesh must reproduce the single-host build — exact
+centroid parity for the sharded Lloyd loop at f32 reduce, exact ring-kNN
+graph parity against the single-host exact graph, recall parity against
+the brute-force oracle for every buildable kind — plus the quantized
+reduce-collective recall bound, build-phase observability (gauges, the
+``build_complete`` event), filtered search over the freshly built
+layout, zero post-warmup recompiles when the result is promoted into a
+live ``SearchService``, and the Compactor's distributed rebuild leg."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu import obs, serve
+from raft_tpu.cluster import kmeans
+from raft_tpu.comms.comms import local_comms
+from raft_tpu.core.bitset import RowFilter
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, nn_descent
+from raft_tpu.obs import events
+from raft_tpu.serve.build import build_sharded, knn_graph_sharded
+from raft_tpu.serve.compactor import CompactionPolicy, Compactor
+from raft_tpu.serve.metrics import compile_count
+from raft_tpu.serve.shard import ShardedIndex
+from raft_tpu.stats import recall_at_k
+
+N, D, NQ, K = 640, 24, 16, 10
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device host mesh"
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((N, D)).astype(np.float32)
+    q = rng.standard_normal((NQ, D)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return local_comms(8)
+
+
+def _oracle(x, q, k):
+    _, ids = brute_force.knn(x, q, k)
+    return np.asarray(ids)
+
+
+def _params(kind):
+    """(index_params, exhaustive search_params) so the probed set is the
+    whole index and recall parity is attributable to the build alone."""
+    if kind == "brute_force":
+        return None, None
+    if kind == "ivf_flat":
+        return (ivf_flat.IndexParams(n_lists=16, seed=3),
+                ivf_flat.SearchParams(n_probes=16))
+    if kind == "ivf_pq":
+        return (ivf_pq.IndexParams(n_lists=16, pq_dim=24, pq_bits=8, seed=3),
+                ivf_pq.SearchParams(n_probes=16))
+    return (cagra.IndexParams(graph_degree=32, intermediate_graph_degree=48),
+            cagra.SearchParams(itopk_size=128))
+
+
+# ---------------------------------------------------------------------------
+# tentpole: sharded build == single-host build
+
+
+@pytest.mark.parametrize("kind", ("brute_force", "ivf_flat", "ivf_pq",
+                                  "cagra"))
+def test_sharded_build_recall_parity(corpus, comms, kind):
+    """The 8-device build must serve the brute-force oracle's neighbors
+    as well as the single-host build of the same kind does."""
+    x, q = corpus
+    ip, sp = _params(kind)
+    sh = build_sharded(kind, x, comms, index_params=ip, search_params=sp)
+    assert isinstance(sh, ShardedIndex)
+    assert sh.n_shards == 8 and sh.size == N
+    gt = _oracle(x, q, K)
+    _, i = sh.search(q, K)
+    rec = recall_at_k(np.asarray(i), gt)
+    if kind in ("brute_force", "ivf_flat"):
+        # exact structure + exhaustive probing: the oracle itself
+        assert rec == 1.0
+    else:
+        # approximate kinds: match the single-host build's recall
+        if kind == "ivf_pq":
+            ref = ivf_pq.build(ip, x)
+            _, iref = ivf_pq.search(sp, ref, q, K)
+        else:
+            ref = cagra.build(
+                cagra.IndexParams(graph_degree=32, build_algo="brute_force"),
+                x,
+            )
+            _, iref = cagra.search(sp, ref, q, K)
+        ref_rec = recall_at_k(np.asarray(iref), gt)
+        assert rec >= ref_rec - 0.05
+        assert rec >= 0.75
+
+
+def test_sharded_lloyd_exact_centroid_parity(corpus, comms):
+    """With a shared init and f32 reduce, the one-psum-per-iteration
+    sharded Lloyd loop is the single-host loop: centroids match to
+    float tolerance, not just in aggregate quality."""
+    x, _ = corpus
+    rng = np.random.default_rng(5)
+    init = x[rng.choice(N, size=16, replace=False)].copy()
+    params = kmeans.KMeansParams(n_clusters=16, max_iter=8, init="array",
+                                 seed=0)
+    c_ref, inertia_ref, _ = kmeans.fit(params, x, init_centers=init)
+    c_sh, inertia_sh, _ = kmeans.fit_sharded(
+        comms, params, x, init_centers=init, reduce_dtype="float32"
+    )
+    np.testing.assert_allclose(
+        np.asarray(c_sh), np.asarray(c_ref), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(inertia_sh), float(inertia_ref), rtol=1e-4
+    )
+
+
+def test_ring_knn_graph_exact_parity(corpus, comms):
+    """The ring-of-ppermute graph is partition-invariant: identical to
+    the single-host exact kNN graph, with and without column tiling."""
+    x, _ = corpus
+    ref = np.asarray(nn_descent.build_exact(x, 16).graph)
+    g = knn_graph_sharded(comms, x, 16)
+    np.testing.assert_array_equal(np.asarray(g), ref)
+    # column-tiled exchange (bounds the per-step distance matrix) must
+    # not change a single edge
+    g_tiled = knn_graph_sharded(comms, x, 16, block_rows=32)
+    np.testing.assert_array_equal(np.asarray(g_tiled), ref)
+
+
+def test_cagra_pruned_graph_parity(corpus, comms):
+    """The full sharded cagra build prunes the ring graph to exactly the
+    single-host optimize() result."""
+    x, _ = corpus
+    ip, sp = _params("cagra")
+    sh = build_sharded("cagra", x, comms, index_params=ip, search_params=sp)
+    ref = np.asarray(
+        cagra.optimize(nn_descent.build_exact(x, 48).graph, 32)
+    )
+    np.testing.assert_array_equal(np.asarray(sh.cagra_graph), ref)
+
+
+def test_quantized_reduce_recall_bound(corpus, comms):
+    """bf16/int8-quantized training psums may perturb centroids but the
+    built index must stay recall-equivalent at exhaustive probing."""
+    x, q = corpus
+    ip, sp = _params("ivf_flat")
+    gt = _oracle(x, q, K)
+    for rd in ("bfloat16", "int8"):
+        sh = build_sharded("ivf_flat", x, comms, index_params=ip,
+                           search_params=sp, reduce_dtype=rd)
+        _, i = sh.search(q, K)
+        assert recall_at_k(np.asarray(i), gt) >= 0.95, rd
+
+
+def test_per_cluster_codebook_build(corpus, comms):
+    x, q = corpus
+    ip = ivf_pq.IndexParams(n_lists=16, pq_dim=24, pq_bits=8, seed=3,
+                            codebook_kind="per_cluster")
+    sp = ivf_pq.SearchParams(n_probes=16)
+    sh = build_sharded("ivf_pq", x, comms, index_params=ip, search_params=sp)
+    _, i = sh.search(q, K)
+    assert recall_at_k(np.asarray(i), _oracle(x, q, K)) >= 0.75
+
+
+# ---------------------------------------------------------------------------
+# satellite: RaggedSpec(filters=...) lifted — filtered sharded search
+
+
+def test_sharded_filtered_search_matches_masked_oracle(corpus, comms):
+    x, q = corpus
+    rng = np.random.default_rng(7)
+    masks = rng.random((NQ, N)) < 0.5
+    masks[:, :K] = True  # every row keeps at least K candidates
+    rf = RowFilter.from_mask_rows(jax.numpy.asarray(masks))
+    for kind in ("brute_force", "ivf_flat"):
+        ip, sp = _params(kind)
+        sh = build_sharded(kind, x, comms, index_params=ip, search_params=sp)
+        _, i = sh.search(q, K, sample_filter=rf)
+        i = np.asarray(i)
+        for r in range(NQ):
+            allowed = np.flatnonzero(masks[r])
+            dd = ((x[allowed] - q[r]) ** 2).sum(-1)
+            ref = allowed[np.argsort(dd, kind="stable")[:K]]
+            assert set(i[r]) == set(ref), (kind, r)
+
+
+def test_ragged_service_filters_over_sharded_index(corpus, comms):
+    """The RaggedSpec(filters=False) restriction is lifted: a ragged
+    service serves per-request filters over a ShardedIndex, with
+    per-request k masking, matching the masked brute-force oracle."""
+    x, q = corpus
+    ip, sp = _params("ivf_flat")
+    sh = build_sharded("ivf_flat", x, comms, index_params=ip,
+                       search_params=sp)
+    even = np.zeros(N, bool)
+    even[::2] = True
+    band = np.zeros(N, bool)
+    band[:200] = True
+    svc = serve.SearchService(k=K, max_batch=8, max_delay_ms=0.2,
+                              start=False, ragged=serve.RaggedSpec(k_max=K))
+    try:
+        svc.add_index("s", sh)
+        fids = (0, svc.register_filter("s", even),
+                svc.register_filter("s", band))
+        svc.warmup("s")
+        masks = {0: np.ones(N, bool), 1: even, 2: band}
+        reqs = [(q[j], 3 + j % (K - 2), j % 3) for j in range(6)]
+        futs = [svc.submit("s", qq, k=k, fid=fids[f]) for qq, k, f in reqs]
+        svc.flush("s")
+        for (qq, k, f), fut in zip(reqs, futs):
+            d, i = fut.result(timeout=60)
+            assert i.shape == (k,)
+            allowed = np.flatnonzero(masks[f])
+            dd = ((x[allowed] - qq) ** 2).sum(-1)
+            ref = allowed[np.argsort(dd, kind="stable")[:k]]
+            assert set(np.asarray(i)) == set(ref)
+    finally:
+        svc.stop()
+
+
+def test_sharded_filter_type_checked(corpus, comms):
+    x, q = corpus
+    sh = build_sharded("brute_force", x, comms)
+    with pytest.raises(TypeError, match="RowFilter"):
+        sh.search(q, K, sample_filter=np.ones(N, bool))
+
+
+# ---------------------------------------------------------------------------
+# satellite: build-progress observability
+
+
+def test_build_observability(corpus, comms):
+    x, _ = corpus
+    seen = []
+    sub = events.subscribe(seen.append, kinds=frozenset({"build_complete"}))
+    try:
+        ip, sp = _params("ivf_flat")
+        build_sharded("ivf_flat", x, comms, index_params=ip,
+                      search_params=sp, label="obs_build")
+    finally:
+        sub.unsubscribe()
+    assert len(seen) == 1
+    ev = seen[0]
+    assert ev.fields["index"] == "obs_build"
+    assert ev.fields["index_kind"] == "ivf_flat"
+    assert ev.fields["rows"] == N and ev.fields["shards"] == 8
+    assert ev.fields["seconds"] > 0
+    snap = obs.default_registry().snapshot()
+    phase = snap["gauges"].get("raft_tpu_build_phase", {})
+    assert any("index=obs_build" in s for s in phase)
+    rows = snap["gauges"].get("raft_tpu_build_rows_done", {})
+    assert any("index=obs_build" in s and v == float(N)
+               for s, v in rows.items())
+
+
+# ---------------------------------------------------------------------------
+# serve integration: promotion into a live service, zero recompiles
+
+
+def test_fresh_build_serves_with_zero_post_warmup_recompiles(corpus, comms):
+    x, q = corpus
+    ip, sp = _params("ivf_flat")
+    sh = build_sharded("ivf_flat", x, comms, index_params=ip,
+                       search_params=sp, label="fresh")
+    svc = serve.SearchService(k=K, max_batch=8, max_delay_ms=0.2)
+    try:
+        svc.add_index("fresh", sh, warmup=True)
+        c0 = compile_count()
+        for j in range(6):
+            _, ids = svc.search("fresh", q[j], timeout=60)
+            assert ids.shape == (K,)
+        assert compile_count() - c0 == 0, (
+            "serving a freshly built sharded index recompiled post-warmup"
+        )
+    finally:
+        svc.stop()
+
+
+def test_compactor_rebuild_sharded(corpus, comms):
+    """Compactor.rebuild_sharded: gather the live set, retrain it over
+    the mesh, hot-swap the ShardedIndex in, retire the writer loudly."""
+    x, q = corpus
+    rng = np.random.default_rng(3)
+    svc = serve.SearchService(k=K, max_batch=4, max_delay_ms=0.2,
+                              compaction=False)
+    try:
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x)
+        mi = serve.MutableIndex(
+            idx, search_params=ivf_flat.SearchParams(n_probes=16)
+        )
+        svc.add_index("main", mi, warmup=False)
+        dead = rng.choice(N, size=60, replace=False)
+        mi.delete(dead)
+        extra = rng.standard_normal((24, D)).astype(np.float32)
+        new_ids = np.asarray(mi.upsert(extra))
+
+        comp = Compactor(
+            svc, CompactionPolicy(chunk_rows=128, max_side_rows=8),
+            start=False,
+        )
+        out = comp.rebuild_sharded("main", comms)
+        assert out["status"] == "promoted"
+        assert out["rows"] == N - 60 + 24
+        assert out["shards"] == 8
+        cur = svc.registry.get("main")
+        assert isinstance(cur, ShardedIndex)
+
+        # positions map through ids back to the live global-id oracle
+        keep = np.setdiff1d(np.arange(N), dead)
+        live_ids = np.concatenate([keep, new_ids])
+        live_rows = np.concatenate([x[keep], extra])
+        ids = np.asarray(out["ids"])
+        assert set(ids) == set(live_ids)
+        gt = live_ids[_oracle(live_rows, q, K)]
+        _, i = cur.search(q, K)
+        assert recall_at_k(ids[np.asarray(i)], gt) >= 0.95
+
+        # a stale writer fails loudly instead of mutating a dead index
+        with pytest.raises(NotImplementedError, match="immutable"):
+            mi.delete(np.array([0]))
+        # second call: the entry is no longer mutable
+        assert comp.rebuild_sharded("main")["status"] == "noop"
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# validation
+
+
+def test_build_rejects_unknown_kind(corpus, comms):
+    x, _ = corpus
+    with pytest.raises(ValueError, match="unsupported index kind"):
+        build_sharded("nn_descent", x, comms)

@@ -49,10 +49,11 @@ def test_entry_point_discovery_is_not_vacuous(project):
 
 
 def test_serve_surface_discovery_is_not_vacuous(result):
-    # all seventeen online entry points (service/mutation/ragged/compactor
-    # plus the SLO evaluator, incident ingest, the overload trio and the
-    # perf-ledger pair) checked, against exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 17, result.stats
+    # all twenty online entry points (service/mutation/ragged/compactor
+    # plus the SLO evaluator, incident ingest, the overload trio, the
+    # perf-ledger pair, the sharded rebuild, and the two module-level
+    # build entry points) checked, against exactly one MicroBatcher
+    assert result.stats["traced_serve_entries_checked"] == 20, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
     assert result.stats["traced_labels"] >= 20, result.stats
 
