@@ -201,6 +201,9 @@ def main() -> None:
     if "obs" in sys.argv[1:]:
         run_obs_leg()
         return
+    if "paged" in sys.argv[1:]:
+        run_paged_leg()
+        return
     if "flight" in sys.argv[1:]:
         run_flight_leg()
         return
@@ -2142,6 +2145,163 @@ def run_obs_leg() -> None:
             ),
             "slow_queries": len(snap["slow_queries"]["recent"]),
             "requests": n_requests,
+        }
+    )
+
+
+def run_paged_leg() -> None:
+    """``python bench.py paged`` — paged-vs-monolithic search A/B (CPU).
+
+    Three arms over the same ivf_flat build, dispatched in identical
+    small batches:
+
+    * ``mono`` — the unpaged control (``RAFT_TPU_PAGED`` off is the
+      production default, so this arm is the baseline every ratio is
+      against);
+    * ``paged_resident`` — the index paginated with an unconstrained
+      budget, so every page fits the HBM hot pool: this is the ≤10%-
+      overhead acceptance arm (page-table gather + per-dispatch
+      coarse/residency bookkeeping is the only delta);
+    * ``paged_overbudget`` — the hot pool deliberately sized *smaller*
+      than the page set (slots < pages), which a monolithic index cannot
+      serve at all; the clock pager demand-fetches each batch's probed
+      pages, so this arm's QPS carries the host↔device paging tax and
+      its eviction counters land in the payload.
+
+    The paged gather is bit-identical to the monolithic gather for
+    resident pages, so all three arms must return *identical* ids — that
+    is asserted, not measured as recall.  Post-warmup recompiles must
+    read 0 on the mono and resident arms: the hot pool is a static shape
+    and the search executables never see the pager.  The over-budget arm
+    is allowed a tiny straggler count — page-movement scatters are
+    pow2-bucketed, so their compiled-shape universe is O(log pages) and
+    a bucket the warmup happened not to hit may land in the timed loop —
+    but the bound is asserted, so an unbounded retrace still fails.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serve.metrics import compile_count, install_compile_listener
+    from raft_tpu.store import MemoryBudget, paginate_index
+
+    install_compile_listener()
+    n, d, k = 32_768, 64, 10
+    n_lists, n_probes = 128, 8
+    page_rows = 128
+    batch, n_batches = 8, 32  # small batches keep each probed-page union
+    n_q = batch * n_batches   # well under the over-budget arm's hot pool
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_q, d), dtype=np.float32)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+
+    def build():
+        # deterministic seed → every arm's build is structurally identical
+        return ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), dataset)
+
+    def measure(index, iters=3):
+        """(qps, ids, recompiles) over the batched dispatch driver."""
+        def one_pass():
+            out = [
+                ivf_flat.search(
+                    sp, index, queries[b * batch:(b + 1) * batch], k
+                )[1]
+                for b in range(n_batches)
+            ]
+            jax.block_until_ready(out)
+            return np.concatenate([np.asarray(i) for i in out])
+
+        ids = one_pass()  # warmup: compiles + first residency faults land
+        # warm until compile-stable: the pager's pow2-bucketed movement
+        # scatters compile lazily per padded size, so run passes until a
+        # full pass adds no executables (bounded — the bucket set is
+        # O(log pages))
+        for _ in range(10):
+            c = compile_count()
+            one_pass()
+            if compile_count() == c:
+                break
+        c0 = compile_count()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            one_pass()
+        t = (time.perf_counter() - t0) / iters
+        return round(n_q / t, 1), ids, compile_count() - c0
+
+    arms = {}
+    idx_mono = build()
+    arms["mono"] = {}
+    arms["mono"]["qps"], base_ids, arms["mono"]["recompiles"] = measure(
+        idx_mono
+    )
+
+    idx_res = build()
+    t_res = paginate_index(
+        idx_res, page_rows=page_rows, budget=None, name="bench:resident"
+    )
+    arms["paged_resident"] = {}
+    arms["paged_resident"]["qps"], ids_res, arms["paged_resident"][
+        "recompiles"
+    ] = measure(idx_res)
+    assert t_res.slots == t_res.n_pages, t_res.stats()
+    assert np.array_equal(ids_res, base_ids), (
+        "paged_resident ids diverged from the monolithic control"
+    )
+
+    # over-budget: grant the pager ~60% of the page set — the budget
+    # formula is the TieredStore admission formula run backwards, so the
+    # slot count is exact, not approximate
+    idx_over = build()
+    ppl = -(-idx_over.list_data.shape[1] // page_rows)
+    n_pages = n_lists * ppl
+    page_bytes = page_rows * d * 4
+    slots = int(0.6 * n_pages)
+    budget = MemoryBudget(slots * page_bytes + 4 * n_pages)
+    t_over = paginate_index(
+        idx_over, page_rows=page_rows, budget=budget, name="bench:overbudget"
+    )
+    assert t_over.slots == slots < t_over.n_pages, t_over.stats()
+    arms["paged_overbudget"] = {}
+    arms["paged_overbudget"]["qps"], ids_over, arms["paged_overbudget"][
+        "recompiles"
+    ] = measure(idx_over)
+    assert np.array_equal(ids_over, base_ids), (
+        "paged_overbudget ids diverged from the monolithic control"
+    )
+    st = t_over.stats()
+    arms["paged_overbudget"]["slots"] = st["slots"]
+    arms["paged_overbudget"]["pages"] = st["n_pages"]
+    arms["paged_overbudget"]["evictions"] = st["evictions"]
+    arms["paged_overbudget"]["misses"] = st["misses"]
+    arms["paged_overbudget"]["hits"] = st["hits"]
+
+    for name, a in arms.items():
+        limit = 4 if name == "paged_overbudget" else 0
+        assert a["recompiles"] <= limit, (
+            f"hot path recompiled after warmup ({name}): {arms}"
+        )
+    overhead = 100.0 * (
+        1.0 - arms["paged_resident"]["qps"] / arms["mono"]["qps"]
+    )
+    _emit(
+        {
+            "metric": f"paged_ab_qps_ivf_flat_n{n // 1024}k_k{k}",
+            "value": arms["paged_resident"]["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "arms": arms,
+            "resident_overhead_pct": round(overhead, 1),
+            "ids_identical": True,
+            "recompiles": sum(a["recompiles"] for a in arms.values()),
+            "page_rows": page_rows,
+            "n": n,
+            "n_lists": n_lists,
+            "n_probes": n_probes,
+            "queries": n_q,
         }
     )
 

@@ -68,6 +68,9 @@ SERVE_ENTRY_POINTS = {
     ("serve.overload.HedgedDispatcher", "dispatch"): "serve.hedge.dispatch",
     ("obs.perf.PerfLedger", "record"): "perf.record",
     ("obs.perf.PerfLedger", "evaluate"): "perf.evaluate",
+    ("store.tiered.TieredStore", "ensure_resident"): "store.pager.ensure",
+    ("store.tiered.TieredStore", "prefetch"): "store.pager.prefetch",
+    ("store.tiered.TieredStore", "evict"): "store.pager.evict",
 }
 
 #: module-level (function) serve entry points and their span labels —

@@ -121,6 +121,20 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_COMPACT_RECALL_SLACK", "float", "0.02",
            "gate tolerance: shadow recall may trail serving recall by at "
            "most this"),
+    # -- paged storage -------------------------------------------------------
+    EnvVar("RAFT_TPU_PAGED", "bool", "unset",
+           "1 serves SearchService indexes from paged storage (host "
+           "cold pages + budget-sized HBM hot pool); unpaged monolithic "
+           "buffers stay the default"),
+    EnvVar("RAFT_TPU_PAGE_ROWS", "int", "1024",
+           "rows per storage page (multiple of 8; IVF list capacity "
+           "repads to a page multiple)"),
+    EnvVar("RAFT_TPU_PAGE_HBM_BUDGET_MB", "int", "unset",
+           "hard HBM budget for paged hot pools (and the compactor's "
+           "projected-bytes gate); unset sizes pools to hold every page"),
+    EnvVar("RAFT_TPU_PAGE_PREFETCH_DEPTH", "int", "2",
+           "bounded queue depth of the async page-prefetch worker (full "
+           "queue drops the hint; prefetch is advisory)"),
     # -- distributed build ---------------------------------------------------
     EnvVar("RAFT_TPU_BUILD_REDUCE_DTYPE", "str", "float32",
            "bfloat16/int8 quantizes the per-iteration centroid/codebook "

@@ -53,6 +53,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.kernels.toolkit import fold_topk
 from raft_tpu.ops import cost as ops_cost
+from raft_tpu.store.paged import PagedRows
 
 _INF = float("inf")
 
@@ -66,9 +67,11 @@ def traverse_supported(dataset, itopk: int) -> bool:
     """Routing gate for the fused hop: dense float dataset (f32/bf16 —
     rows upcast in VMEM after the DMA) at fold-friendly buffer widths.
     VPQ datasets decode on gather (no raw rows to DMA) and int8 datasets
-    lack a dequant scale — both keep the XLA hop."""
+    lack a dequant scale — both keep the XLA hop.  A paged dataset
+    (:class:`~raft_tpu.store.paged.PagedRows`) rides the same per-row DMA
+    with one extra prefetched-scalar page-table hop."""
     return (
-        isinstance(dataset, jax.Array)
+        (isinstance(dataset, jax.Array) or isinstance(dataset, PagedRows))
         and jnp.dtype(dataset.dtype)
         in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
         and 0 < itopk <= MAX_ITOPK
@@ -78,10 +81,15 @@ def traverse_supported(dataset, itopk: int) -> bool:
 def _hop_kernel(par_ref, cand_ref, g_blk, q_blk, bd_blk, bi_blk, be_blk,
                 dataset_ref, od_blk, oi_blk, oe_blk, rows_s, md_s, mi_s,
                 sem, *, metric: str, deg: int, itopk: int, width: int,
-                d: int):
+                d: int, page_rows=None, ps_ref=None):
     """One (query, parent) step.  Scratch (rows_s, md_s, mi_s) persists
     across the ``width`` steps of a query; w==0 seeds the merged buffer
-    from the input planes and w==width−1 writes the merged state once."""
+    from the input planes and w==width−1 writes the merged state once.
+
+    ``page_rows``/``ps_ref`` select the paged leg: ``dataset_ref`` is then
+    the HBM page pool ``[slots, page_rows, d]`` and each candidate row DMA
+    translates its global id through the prefetched ``page_slot`` table —
+    the "one more prefetched indirection" the page table costs."""
     t = pl.program_id(0)
     w = pl.program_id(1)
     pid = par_ref[t * width + w]
@@ -97,9 +105,13 @@ def _hop_kernel(par_ref, cand_ref, g_blk, q_blk, bd_blk, bi_blk, be_blk,
     # candidate-id table (invalid ids clamp to row 0; scores masked below)
     def load(j, _):
         cid = jnp.maximum(cand_ref[(t * width + w) * deg + j], 0)
-        cp = pltpu.make_async_copy(
-            dataset_ref.at[pl.ds(cid, 1), :], rows_s.at[pl.ds(j, 1), :], sem
-        )
+        if page_rows is None:
+            src = dataset_ref.at[pl.ds(cid, 1), :]
+        else:
+            pg = cid // page_rows
+            slot = jnp.maximum(ps_ref[pg], 0)
+            src = dataset_ref.at[slot, pl.ds(cid - pg * page_rows, 1), :]
+        cp = pltpu.make_async_copy(src, rows_s.at[pl.ds(j, 1), :], sem)
         cp.start()
         cp.wait()
         return 0
@@ -165,8 +177,22 @@ def _hop_kernel(par_ref, cand_ref, g_blk, q_blk, bd_blk, bi_blk, be_blk,
         oe_blk[0] = exp.astype(jnp.int32)
 
 
+def _hop_kernel_paged(par_ref, cand_ref, ps_ref, g_blk, q_blk, bd_blk,
+                      bi_blk, be_blk, pool_ref, od_blk, oi_blk, oe_blk,
+                      rows_s, md_s, mi_s, sem, *, metric: str, deg: int,
+                      itopk: int, width: int, d: int, page_rows: int):
+    """Paged entry point: same hop body, with the page-slot table riding
+    as a third prefetched scalar ahead of the grid operands."""
+    _hop_kernel(
+        par_ref, cand_ref, g_blk, q_blk, bd_blk, bi_blk, be_blk, pool_ref,
+        od_blk, oi_blk, oe_blk, rows_s, md_s, mi_s, sem, metric=metric,
+        deg=deg, itopk=itopk, width=width, d=d, page_rows=page_rows,
+        ps_ref=ps_ref,
+    )
+
+
 def cagra_fused_hop(
-    dataset: jax.Array,      # [n, d] f32/bf16 (stays in HBM; rows DMA'd)
+    dataset,                 # [n, d] f32/bf16 jax.Array or PagedRows
     graph: jax.Array,        # [n, deg] int32
     queries: jax.Array,      # [tile, d] f32
     parents: jax.Array,      # [tile, width] int32, −1 = no parent
@@ -182,6 +208,7 @@ def cagra_fused_hop(
     the enclosing jit."""
     tile, itopk = buf_d.shape
     width = parents.shape[1]
+    paged = isinstance(dataset, PagedRows)
     n, d = dataset.shape
     deg = graph.shape[1]
     # candidate-id table for the DMA scalars: a [tile, width, deg] int32
@@ -195,26 +222,30 @@ def cagra_fused_hop(
     )
     ops_cost.note("cagra_traverse", c)
 
+    # index_maps take *rest so the same lambdas serve 2 (dense) or 3
+    # (paged: + page_slot) prefetched scalar operands
+    def _nbr_map(t, w, par, *rest):
+        return jnp.maximum(par[t * width + w], 0), 0, 0
+
+    def _tile_map(t, w, *rest):
+        return t, 0, 0
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3 if paged else 2,
         grid=(tile, width),
         in_specs=[
-            pl.BlockSpec(       # the parent's neighbor list (dynamic)
-                (1, 1, deg),
-                lambda t, w, par, cd: (
-                    jnp.maximum(par[t * width + w], 0), 0, 0
-                ),
-            ),
-            pl.BlockSpec((1, 1, d), lambda t, w, par, cd: (t, 0, 0)),
-            pl.BlockSpec((1, 1, itopk), lambda t, w, par, cd: (t, 0, 0)),
-            pl.BlockSpec((1, 1, itopk), lambda t, w, par, cd: (t, 0, 0)),
-            pl.BlockSpec((1, 1, itopk), lambda t, w, par, cd: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # dataset stays in HBM
+            # the parent's neighbor list (dynamic)
+            pl.BlockSpec((1, 1, deg), _nbr_map),
+            pl.BlockSpec((1, 1, d), _tile_map),
+            pl.BlockSpec((1, 1, itopk), _tile_map),
+            pl.BlockSpec((1, 1, itopk), _tile_map),
+            pl.BlockSpec((1, 1, itopk), _tile_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # dataset/pool in HBM
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, itopk), lambda t, w, par, cd: (t, 0, 0)),
-            pl.BlockSpec((1, 1, itopk), lambda t, w, par, cd: (t, 0, 0)),
-            pl.BlockSpec((1, 1, itopk), lambda t, w, par, cd: (t, 0, 0)),
+            pl.BlockSpec((1, 1, itopk), _tile_map),
+            pl.BlockSpec((1, 1, itopk), _tile_map),
+            pl.BlockSpec((1, 1, itopk), _tile_map),
         ],
         scratch_shapes=[
             pltpu.VMEM((deg, d), dataset.dtype),    # candidate rows
@@ -223,11 +254,29 @@ def cagra_fused_hop(
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    od, oi, oe = pl.pallas_call(
-        functools.partial(
+    if paged:
+        kern = functools.partial(
+            _hop_kernel_paged, metric=metric, deg=deg, itopk=itopk,
+            width=width, d=d, page_rows=dataset.page_rows,
+        )
+        scalars = (
+            parents.reshape(-1).astype(jnp.int32),
+            cand.reshape(-1).astype(jnp.int32),
+            dataset.page_slot.astype(jnp.int32),
+        )
+        ds_operand = dataset.pool
+    else:
+        kern = functools.partial(
             _hop_kernel, metric=metric, deg=deg, itopk=itopk,
             width=width, d=d,
-        ),
+        )
+        scalars = (
+            parents.reshape(-1).astype(jnp.int32),
+            cand.reshape(-1).astype(jnp.int32),
+        )
+        ds_operand = dataset
+    od, oi, oe = pl.pallas_call(
+        kern,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((tile, 1, itopk), jnp.float32),
@@ -237,13 +286,12 @@ def cagra_fused_hop(
         cost_estimate=c.as_pallas(),
         interpret=interpret,
     )(
-        parents.reshape(-1).astype(jnp.int32),
-        cand.reshape(-1).astype(jnp.int32),
+        *scalars,
         graph.reshape(n, 1, deg),
         queries[:, None, :],
         buf_d[:, None, :],
         buf_i[:, None, :],
         explored[:, None, :].astype(jnp.int32),
-        dataset,
+        ds_operand,
     )
     return od[:, 0, :], oi[:, 0, :], oe[:, 0, :] != 0

@@ -51,6 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.kernels.toolkit import fold_topk, quantize_queries_i8
 from raft_tpu.ops import cost as ops_cost
+from raft_tpu.store.paged import PagedLists
 
 _WORST = float("inf")
 
@@ -183,6 +184,122 @@ def _scan_kernel(bucket_list_ref, dec_ref, y2_ref, ids_ref, filt_ref, qg_ref,
     out_ids_ref[0] = i
 
 
+def _scan_paged_kernel(bucket_list_ref, page_slot_ref, dec_ref, y2_ref,
+                       ids_ref, qg_ref, q2_ref, scale_ref, vals_ref,
+                       out_ids_ref, run_v_ref, run_i_ref, *, kk: int,
+                       ppl: int, pr: int, metric: str, scan_dtype: str):
+    """Paged probe-major step: grid (B, ppl) walks the bucket's list one
+    *page* at a time.  The dec block rides the page-table indirection —
+    TWO prefetched scalars compose in its index_map
+    (``page_slot[bucket_list[b] * ppl + j]``), so the hot pool's slot
+    order is invisible to the kernel body.  y2/ids stay monolithic
+    [1, cap] blocks (device-resident sidecars) sliced per page in VMEM;
+    the per-query top-kk accumulates across pages in scratch and is
+    written once on the last page (the qm kernel's accumulate-then-fold
+    shape, folded incrementally so no [G, cap] pool materializes)."""
+    G = qg_ref.shape[1]
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _reset():
+        run_v_ref[...] = jnp.full((G, kk), _WORST, jnp.float32)
+        run_i_ref[...] = jnp.full((G, kk), -1, jnp.int32)
+
+    y2_row = jax.lax.dynamic_slice_in_dim(y2_ref[0], j * pr, pr, axis=1)
+    ids_row = jax.lax.dynamic_slice_in_dim(ids_ref[0], j * pr, pr, axis=1)
+    scores, cand_i = _score_against_list(
+        dec_ref[0], qg_ref[0], q2_ref[0], y2_row, ids_row,
+        jnp.zeros((1, 1), jnp.uint32), scale_ref[0, 0],
+        metric=metric, filtered=False, scan_dtype=scan_dtype,
+    )
+    v, i = fold_topk(run_v_ref[...], run_i_ref[...], scores, cand_i, kk)
+    run_v_ref[...] = v
+    run_i_ref[...] = i
+
+    @pl.when(j == ppl - 1)
+    def _emit():
+        vf = run_v_ref[...]
+        vals_ref[0] = vf
+        out_ids_ref[0] = jnp.where(jnp.isfinite(vf), run_i_ref[...], -1)
+
+
+def paged_scan_supported(list_data, kk: int, filtered: bool) -> bool:
+    """Routing gate for the paged probe-major leg: the per-page fold
+    caps the candidate pool at ``page_rows`` per step (so ``kk`` may not
+    exceed it) and filtered searches keep the XLA schedule (the packed
+    word table is capacity-indexed, not page-indexed)."""
+    if not isinstance(list_data, PagedLists):
+        return False
+    pr = list_data.page_rows
+    return (not filtered) and kk <= pr and pr % 8 == 0
+
+
+def _ivf_scan_probe_major_paged(
+    bucket_list, q_gathered, q2_gathered, list_data: PagedLists, list_y2,
+    list_index, kk, *, metric, scan_dtype, scan_scale, interpret,
+):
+    """Paged body of :func:`ivf_scan_probe_major` (same contract)."""
+    B, G, rot = q_gathered.shape
+    L, cap = list_data.shape[:2]
+    ppl = list_data.pages_per_list
+    pr = list_data.page_rows
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, ppl),
+        in_specs=[
+            pl.BlockSpec(       # dec: page j of the bucket's list, via
+                (1, pr, rot),   # the device page table (slot −1 of a
+                                # non-probed padding list clamps to 0;
+                                # its scores die on the q2=+inf mask)
+                lambda b, j, bl, ps: (
+                    jnp.maximum(ps[bl[b] * ppl + j], 0), 0, 0
+                ),
+            ),
+            pl.BlockSpec((1, 1, cap), lambda b, j, bl, ps: (bl[b], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda b, j, bl, ps: (bl[b], 0, 0)),
+            pl.BlockSpec((1, G, rot), lambda b, j, bl, ps: (b, 0, 0)),
+            pl.BlockSpec((1, G, 1), lambda b, j, bl, ps: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),       # scan_scale
+        ],
+        out_specs=[
+            pl.BlockSpec((1, G, kk), lambda b, j, bl, ps: (b, 0, 0)),
+            pl.BlockSpec((1, G, kk), lambda b, j, bl, ps: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((G, kk), jnp.float32),   # running top-kk values
+            pltpu.VMEM((G, kk), jnp.int32),     # running top-kk ids
+        ],
+    )
+    c = ops_cost.ivf_scan_cost(
+        B, G, cap, rot, kk, itemsize=list_data.dtype.itemsize
+    )
+    ops_cost.note("ivf_scan_probe_major_paged", c)
+    vals, ids = pl.pallas_call(
+        functools.partial(
+            _scan_paged_kernel, kk=kk, ppl=ppl, pr=pr, metric=metric,
+            scan_dtype=scan_dtype,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, G, kk), jnp.float32),
+            jax.ShapeDtypeStruct((B, G, kk), jnp.int32),
+        ],
+        cost_estimate=c.as_pallas(),
+        interpret=interpret,
+    )(
+        bucket_list,
+        list_data.page_slot,
+        list_data.pool,
+        list_y2[:, None, :],
+        list_index[:, None, :],
+        q_gathered,
+        q2_gathered[:, :, None],
+        jnp.asarray(scan_scale, jnp.float32).reshape(1, 1),
+    )
+    return vals, ids
+
+
 @functools.partial(
     jax.jit, static_argnames=("kk", "metric", "scan_dtype", "interpret")
 )
@@ -206,7 +323,18 @@ def ivf_scan_probe_major(
     _common.merge_probe_major_partials.  The caller supplies the
     pre-gathered bucket queries (one [B, G, rot] HBM pass — tiny next to
     the list stream this schedule saves) and, for filtered searches, the
-    ``pack_list_filter`` word table."""
+    ``pack_list_filter`` word table.
+
+    A :class:`~raft_tpu.store.paged.PagedLists` ``list_data`` takes the
+    paged leg (grid (B, pages_per_list), dec indirected through the
+    device page table; gate with :func:`paged_scan_supported`)."""
+    if isinstance(list_data, PagedLists):
+        assert list_filter is None, "paged pallas leg is unfiltered-only"
+        return _ivf_scan_probe_major_paged(
+            bucket_list, q_gathered, q2_gathered, list_data, list_y2,
+            list_index, kk, metric=metric, scan_dtype=scan_dtype,
+            scan_scale=scan_scale, interpret=interpret,
+        )
     B, G, rot = q_gathered.shape
     L, cap, _ = list_data.shape
     filtered = list_filter is not None

@@ -6,6 +6,7 @@ identical between IVF-Flat and IVF-PQ (ref: the reference shares them via
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -546,3 +547,41 @@ def allocate_append_slots(centers, list_sizes, cap, labels, group_inverse=None):
             if i == len(rows):
                 break
     return out_list, out_slot, sizes - np.asarray(list_sizes)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "n_probes"))
+def _coarse_probes_jit(queries, centers, metric, n_probes):
+    """Standalone coarse pass for the paged prefix (same math the search
+    executables re-derive in-trace — deterministic, so both agree)."""
+    return coarse_select(queries, centers, metric, n_probes)
+
+
+def paged_lists_for_search(index, queries, metric: str, n_probes: int):
+    """Paged-search prefix shared by ivf_flat/ivf_pq: run the coarse
+    pass, key the pager by the probed lists (async prefetch hint, then
+    blocking admission), and hand back the :class:`PagedLists` device
+    view the unchanged search executables scan through.
+
+    The coarse top-n_probes runs twice (here and inside the search
+    executable) — one tiny [q, L] GEMM, cheap next to the list scan, and
+    the price of keeping the scan executables byte-identical to the
+    monolithic arm."""
+    from raft_tpu.store.paged import PagedLists, pages_for_lists
+
+    tiered = index.paged
+    if tiered.slots == tiered.n_pages:
+        # fully-resident pool: pin the identity mapping once and skip the
+        # per-dispatch coarse/residency bookkeeping entirely — nothing can
+        # ever be evicted, so the page table is immutable after the pin.
+        # This is what keeps the HBM-resident paged arm within a few
+        # percent of the monolithic control (bench.py paged).
+        tiered.pin_identity()
+        pool, page_slot = tiered.view()
+        return PagedLists(pool, page_slot, tiered.pages_per_list)
+    probes = _coarse_probes_jit(queries, index.centers, metric, n_probes)
+    lists = np.unique(np.asarray(probes))  # raft-tpu: ignore[HOSTSYNC] prefetch keying needs the probed lists on host before dispatch
+    pages = pages_for_lists(lists, tiered.pages_per_list)
+    tiered.prefetch(pages)
+    tiered.ensure_resident(pages)
+    pool, page_slot = tiered.view()
+    return PagedLists(pool, page_slot, tiered.pages_per_list)

@@ -290,8 +290,19 @@ def search(
     deleted_mask=None,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
+    # paged index: every row is scanned each dispatch, so the whole
+    # dataset must sit in the hot pool — identity-pin it once (single
+    # host→HBM transfer; BudgetExceeded if the pool is short) and hand
+    # the flat pool view to the unchanged knn (bitwise-identical rows)
+    paged = getattr(index, "paged", None)
+    if paged is not None:
+        paged.pin_identity()
+        pool, _ = paged.view()
+        dataset = pool.reshape((-1,) + pool.shape[2:])[: index.size]
+    else:
+        dataset = index.dataset
     return knn(
-        index.dataset, queries, k, metric=index.metric,
+        dataset, queries, k, metric=index.metric,
         sample_filter=sample_filter, deleted_mask=deleted_mask, res=res,
     )
 

@@ -783,15 +783,30 @@ def search(
     from raft_tpu import kernels as _kernels
     from raft_tpu.kernels.cagra_traverse import traverse_supported
 
+    # paged index: beam-search gathers are graph-hop-dependent, so no
+    # probe-keyed prefetch exists — the whole dataset must sit in the hot
+    # pool (identity-pinned once; BudgetExceeded from pin_identity
+    # otherwise — raise the budget, or serve over-HBM payloads from the
+    # IVF backends whose working set is probe-bounded)
+    paged = getattr(index, "paged", None)
+    if paged is not None:
+        from raft_tpu.store.paged import PagedRows
+
+        paged.pin_identity()
+        pool, page_slot = paged.view()
+        dataset = PagedRows(pool, page_slot, index.size)
+    else:
+        dataset = index.dataset
+
     fused = (
         fw is None
         and _kernels.use_pallas()
         and _kernels.cagra_fused_enabled()
-        and traverse_supported(index.dataset, itopk)
+        and traverse_supported(dataset, itopk)
     )
     _kernels.stamp_kernel_path("pallas" if fused else "xla")
     return _search_jit(
-        index.dataset, index.graph, queries, fw, seed_ids,
+        dataset, index.graph, queries, fw, seed_ids,
         int(k), int(itopk), int(width), int(max_iter), int(min_iter),
         metric, int(tile), fused=fused,
     )

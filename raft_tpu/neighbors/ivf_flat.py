@@ -58,6 +58,7 @@ from raft_tpu.neighbors._common import (
 )
 from raft_tpu.kernels import stamp_kernel_path as _stamp_kernel_path
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.store.paged import gather_lists as _gather_lists
 from raft_tpu.core.trace import traced
 from raft_tpu.core.logger import logger as _log
 
@@ -278,6 +279,12 @@ def extend(
     recompile-tier strategy for XLA static shapes (SURVEY §7 hard part 4).
     """
     res = ensure(res)
+    if getattr(index, "paged", None) is not None:
+        raise ValueError(
+            "extend() on a paged index is unsupported — paged serving "
+            "routes growth through MutableIndex side buffers and "
+            "re-paginates at compaction (see docs/paged_storage.md)"
+        )
     x = (
         new_vectors
         if isinstance(new_vectors, np.ndarray)
@@ -412,7 +419,8 @@ def _search_jit(
 
     def tile(args):
         qq, pp, fw_t = args  # [t, d], [t, p], [t, W]
-        data = list_data[pp].astype(jnp.float32)      # [t, p, cap, d] gather
+        # [t, p, cap, d] gather (page-table indirected when paged)
+        data = _gather_lists(list_data, pp).astype(jnp.float32)
         ids = list_index[pp]                          # [t, p, cap]
         norms = list_norms[pp]                        # [t, p, cap]
         # distance epilogue per metric
@@ -483,7 +491,7 @@ def _search_probe_major_jit(
     qn = jnp.maximum(jnp.sqrt(q2), 1e-12)
 
     def score_fn(bl, bq):
-        data = list_data[bl].astype(jnp.float32)                   # [bb, cap, d]
+        data = _gather_lists(list_data, bl).astype(jnp.float32)    # [bb, cap, d]
         ids = list_index[bl]
         norms = list_norms[bl]
         qq = queries[jnp.clip(bq, 0)]                              # [bb, G, d]
@@ -664,8 +672,25 @@ def search(
         req_strategy, queries.shape[0], n_probes, index.n_lists,
         index.list_cap, index.dim, res.workspace_limit_bytes, k=int(k),
     )
+    # paged storage: run the coarse pass up front, admit the probed
+    # lists' pages, then scan through the page-table device view — the
+    # search executables below are the ones the monolithic arm compiles
+    paged = getattr(index, "paged", None)
+    if paged is not None:
+        from raft_tpu.neighbors._common import paged_lists_for_search
+
+        list_data = paged_lists_for_search(index, queries, canonical, n_probes)
+    else:
+        list_data = index.list_data
     if strategy == "probe_major":
-        if pallas_scan_enabled(canonical, index.list_data.dtype):
+        use_pallas = pallas_scan_enabled(canonical, list_data.dtype)
+        if paged is not None and use_pallas:
+            from raft_tpu.kernels.ivf_scan import paged_scan_supported
+
+            use_pallas = paged_scan_supported(
+                list_data, min(int(k), index.list_cap), fw is not None
+            )
+        if use_pallas:
             from raft_tpu.kernels import interpret_mode
             from raft_tpu.kernels.ivf_scan import pack_list_filter
 
@@ -678,7 +703,7 @@ def search(
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
-                    qt, index.centers, index.list_data, index.list_index,
+                    qt, index.centers, list_data, index.list_index,
                     index.list_norms, lf, n_probes, int(k), canonical,
                     bucket, interpret_mode(),
                 )
@@ -689,7 +714,7 @@ def search(
                 return _search_probe_major_jit(
                     qt,
                     index.centers,
-                    index.list_data,
+                    list_data,
                     index.list_index,
                     index.list_norms,
                     fw,
@@ -707,7 +732,8 @@ def search(
 
     has_descriptor = per_row and getattr(sample_filter, "table", None) is not None
     if (
-        pallas_scan_enabled(canonical, index.list_data.dtype)
+        paged is None  # query-major kernel streams whole monolithic lists
+        and pallas_scan_enabled(canonical, list_data.dtype)
         and (not per_row or has_descriptor)
         and _scan_mod.qm_scratch_bytes(n_probes, index.list_cap)
         <= _scan_mod.QM_VMEM_BUDGET
@@ -760,7 +786,7 @@ def search(
     return _search_jit(
         queries,
         index.centers,
-        index.list_data,
+        list_data,
         index.list_index,
         index.list_norms,
         fw,

@@ -77,6 +77,7 @@ from raft_tpu.neighbors._common import (
 from raft_tpu.kernels import stamp_kernel_path as _stamp_kernel_path
 from raft_tpu.kernels.toolkit import int8_scored_ip, quantize_queries_i8
 from raft_tpu.ops.matrix import select_k
+from raft_tpu.store.paged import gather_lists as _gather_lists
 from raft_tpu.core.trace import traced
 from raft_tpu.core.logger import logger as _log
 
@@ -916,6 +917,12 @@ def extend(
     host-resident: each tile is uploaded as it is encoded, and only the
     compressed stream (codes pq_dim B/row + labels) is retained — bounded
     host residency for 10⁸-row builds."""
+    if getattr(index, "paged", None) is not None:
+        raise ValueError(
+            "extend() on a paged index is unsupported: paged serving routes "
+            "growth through MutableIndex side buffers and re-paginates at "
+            "compaction"
+        )
     res = ensure(res)
     x = new_vectors if isinstance(new_vectors, np.ndarray) else jnp.asarray(new_vectors)
     canonical = DISTANCE_TYPES[index.metric]
@@ -1066,7 +1073,7 @@ def _search_jit(
 
     def tile(args):
         qr, pp, fw_t = args  # [t, rot_dim], [t, p], [t, W]
-        dec = list_data[pp]                              # [t, p, cap, rot]
+        dec = _gather_lists(list_data, pp)               # [t, p, cap, rot]
         ids = list_index[pp]                             # [t, p, cap]
         y2 = list_y2[pp]                                 # [t, p, cap]
         # ip[t,p,c] = q_rot[t]·y[t,p,c] — batched over t, contracting rot
@@ -1159,7 +1166,7 @@ def _search_probe_major_jit(
     q2 = jnp.sum(q_rot * q_rot, axis=1)                         # [q]
 
     def score_fn(bl, bq):
-        dec = list_data[bl]                                        # [bb, cap, rot]
+        dec = _gather_lists(list_data, bl)                         # [bb, cap, rot]
         ids = list_index[bl]                                       # [bb, cap]
         y2 = list_y2[bl]
         qr = q_rot[jnp.clip(bq, 0)]                                # [bb, G, rot]
@@ -1357,10 +1364,27 @@ def search(
         req_strategy, queries.shape[0], n_probes, index.n_lists,
         index.list_cap, index.rot_dim, res.workspace_limit_bytes, k=int(k),
     )
+    # paged index: prefetch + pin the probed lists' pages before the scan
+    # executables dispatch; ``list_data`` becomes the PagedLists view and
+    # the schedules below gather through the page table transparently
+    paged = getattr(index, "paged", None)
+    if paged is not None:
+        from raft_tpu.neighbors._common import paged_lists_for_search
+
+        list_data = paged_lists_for_search(index, queries, canonical, n_probes)
+    else:
+        list_data = index.list_data
     if strategy == "probe_major":
-        if pallas_scan_enabled(
-            canonical, index.list_data.dtype, allow_int8=True
-        ) and params.internal_distance_dtype == "float32":
+        use_pallas = pallas_scan_enabled(
+            canonical, list_data.dtype, allow_int8=True
+        ) and params.internal_distance_dtype == "float32"
+        if paged is not None and use_pallas:
+            from raft_tpu.kernels.ivf_scan import paged_scan_supported
+
+            use_pallas = paged_scan_supported(
+                list_data, min(int(k), index.list_cap), fw is not None
+            )
+        if use_pallas:
             # the kernel accumulates f32 only; a bf16 internal-distance
             # request must keep the XLA leg (preferred_element_type=
             # acc_dtype) or the two legs rank near-ties differently
@@ -1376,7 +1400,7 @@ def search(
 
             def run_pm(qt):
                 return _search_probe_major_pallas(
-                    qt, index.centers, index.rotation, index.list_data,
+                    qt, index.centers, index.rotation, list_data,
                     index.list_y2, index.list_index, lf,
                     float(index.scan_scale), n_probes, int(k),
                     canonical, bucket, params.lut_dtype, interpret_mode(),
@@ -1389,7 +1413,7 @@ def search(
                     qt,
                     index.centers,
                     index.rotation,
-                    index.list_data,
+                    list_data,
                     index.list_y2,
                     index.list_index,
                     fw,
@@ -1410,7 +1434,10 @@ def search(
 
     has_descriptor = per_row and getattr(sample_filter, "table", None) is not None
     if (
-        pallas_scan_enabled(canonical, index.list_data.dtype, allow_int8=True)
+        # the fused query-major kernel has no paged leg (dense [L, cap]
+        # block specs); paged searches ride the XLA gather below
+        paged is None
+        and pallas_scan_enabled(canonical, list_data.dtype, allow_int8=True)
         and params.internal_distance_dtype == "float32"
         # per-row filters stay fused when they carry the packed
         # descriptor (RowFilter.from_table); ad-hoc [q, w] word planes
@@ -1465,7 +1492,7 @@ def search(
             run_qm, queries, _scan_mod.qm_query_tile(n_probes)
         )
     # per-query workspace: probe gather of decoded rows + scores + ids
-    if index.list_data.dtype == jnp.int8:
+    if list_data.dtype == jnp.int8:
         itemsize = 1
     else:
         itemsize = 2 if scan_dtype == jnp.bfloat16 else 4
@@ -1478,7 +1505,7 @@ def search(
         queries,
         index.centers,
         index.rotation,
-        index.list_data,
+        list_data,
         index.list_y2,
         index.list_index,
         fw,

@@ -296,6 +296,12 @@ def refresh_live_buffer_gauges(
     live: Dict[str, float] = {}
     alive_keys = set()
     for (name, version), index in index_registry.live_versions().items():
+        if getattr(getattr(index, "index", None), "paged", None) is not None:
+            # paged versions report through the page-residency gauges
+            # (refresh_page_gauges) — a monolithic live-bytes series for
+            # them would double-count the aliased cold tier; any series a
+            # version published before pagination retires below
+            continue
         try:
             nbytes = float(index.device_bytes())
         except Exception:
@@ -311,6 +317,64 @@ def refresh_live_buffer_gauges(
             if (d["index"], d["version"]) not in alive_keys:
                 gauge.remove(**d)
     return live
+
+
+def refresh_page_gauges(
+    index_registry, registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Publish page-residency gauges for every still-reachable *paged*
+    index version: ``raft_tpu_page_resident{index=,version=}`` (pages in
+    the HBM hot pool), ``raft_tpu_page_host`` (cold pages on host only),
+    and ``raft_tpu_page_pool_bytes`` (device bytes the hot pool + page
+    table reserve from the memory budget).
+
+    Rides the same weak version history as
+    :func:`refresh_live_buffer_gauges` and retires series whose version
+    object the GC collected — the fetch/eviction *flow* counters
+    (``raft_tpu_page_{hits,misses,evictions}_total``) are push-side,
+    bumped by :class:`~raft_tpu.store.tiered.TieredStore` itself.
+    """
+    reg = registry if registry is not None else default_registry()
+    g_res = reg.gauge(
+        "raft_tpu_page_resident",
+        help="HBM-resident pages of each still-reachable paged index version",
+    )
+    g_host = reg.gauge(
+        "raft_tpu_page_host",
+        help="host-only (cold) pages of each still-reachable paged index version",
+    )
+    g_bytes = reg.gauge(
+        "raft_tpu_page_pool_bytes",
+        help="device bytes reserved by each paged version's hot pool",
+    )
+    out: Dict[str, Dict[str, float]] = {}
+    alive = set()
+    for (name, version), index in index_registry.live_versions().items():
+        tiered = getattr(getattr(index, "index", None), "paged", None)
+        if tiered is None:
+            continue
+        try:
+            st = tiered.stats()
+            pool_bytes = float(tiered.nbytes)
+        except Exception:
+            continue
+        labels = {"index": name, "version": str(version)}
+        g_res.set(float(st["resident"]), **labels)
+        g_host.set(float(st["host_only"]), **labels)
+        g_bytes.set(pool_bytes, **labels)
+        alive.add((name, str(version)))
+        out[f"{name}:v{version}"] = {
+            "resident": float(st["resident"]),
+            "host": float(st["host_only"]),
+            "pool_bytes": pool_bytes,
+        }
+    for gauge in (g_res, g_host, g_bytes):
+        for key in gauge.series():
+            d = dict(key)
+            if "index" in d and "version" in d:
+                if (d["index"], d["version"]) not in alive:
+                    gauge.remove(**d)
+    return out
 
 
 def refresh_mutation_gauges(

@@ -71,6 +71,7 @@ KINDS = frozenset({
     "hedge_fired",
     "perf_regression",
     "build_complete",
+    "page_thrash",
 })
 
 #: kinds that open incidents / trigger flight dumps; the rest are context
@@ -84,6 +85,7 @@ TRIGGER_KINDS = frozenset({
     "admission_shed",
     "degraded_enter",
     "perf_regression",
+    "page_thrash",
 })
 
 #: default recent-events ring capacity

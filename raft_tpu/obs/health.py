@@ -268,11 +268,30 @@ def perf_check(perf: Optional[Dict[str, object]]) -> Dict[str, object]:
     return _check(OK, "no active device-time regressions")
 
 
+def budget_check(snapshot: Dict[str, object]) -> Dict[str, object]:
+    """Fold a :meth:`raft_tpu.store.budget.MemoryBudget.snapshot` into a
+    health check: a near-fully-reserved page budget is DEGRADED — the
+    next pagination or page admission will raise ``BudgetExceeded``, so
+    the operator hears about the pressure *before* the loud failure."""
+    limit = float(snapshot.get("limit_bytes", 0) or 0)
+    reserved = float(snapshot.get("reserved_bytes", 0) or 0)
+    util = reserved / limit if limit else 0.0
+    status = DEGRADED if util >= 0.98 else OK
+    out = _check(
+        status,
+        f"page budget {reserved:.0f}/{limit:.0f}B reserved "
+        f"({100.0 * util:.1f}%)",
+    )
+    out["snapshot"] = dict(snapshot)
+    return out
+
+
 def build_report(
     probes: Dict[str, IndexProbe],
     registry: Optional[MetricsRegistry] = None,
     slo: Optional[Dict[str, object]] = None,
     perf: Optional[Dict[str, object]] = None,
+    budget: Optional[Dict[str, object]] = None,
 ) -> Dict[str, object]:
     """Assemble the service-wide report and publish ``raft_tpu_health``.
 
@@ -300,12 +319,15 @@ def build_report(
         statuses.append(rep["status"])
         gauge.set(VERDICT_VALUES[rep["status"]], index=name)
     mem = device_memory_check()
-    budget = slo_check(slo) if slo is not None else None
-    if budget is not None:
-        statuses.append(budget["status"])
+    slo_c = slo_check(slo) if slo is not None else None
+    if slo_c is not None:
+        statuses.append(slo_c["status"])
     perf_c = perf_check(perf) if perf is not None else None
     if perf_c is not None:
         statuses.append(perf_c["status"])
+    budget_c = budget_check(budget) if budget is not None else None
+    if budget_c is not None:
+        statuses.append(budget_c["status"])
     overall = worst(mem["status"], *statuses)
     gauge.set(VERDICT_VALUES[overall], index="overall")
     with _transition_lock:
@@ -329,8 +351,10 @@ def build_report(
         "indexes": indexes,
         "flight": flight.last_dump(),
     }
-    if budget is not None:
-        report["slo"] = budget
+    if slo_c is not None:
+        report["slo"] = slo_c
     if perf_c is not None:
         report["perf"] = perf_c
+    if budget_c is not None:
+        report["budget"] = budget_c
     return report

@@ -65,6 +65,7 @@ from raft_tpu.core.trace import trace_range, traced
 from raft_tpu.distance import DISTANCE_TYPES
 from raft_tpu.serve.mutation import MutableIndex, _next_pow2
 from raft_tpu.stats.metrics import recall_at_k
+from raft_tpu.store.budget import BudgetExceeded, default_budget
 
 _log = _child_logger("serve.compactor")
 
@@ -290,6 +291,11 @@ class Compactor:
             self._idle.clear()
             try:
                 result = self._compact_inner(name)
+            except BudgetExceeded as exc:
+                # shadow pagination blew the shared page budget — same
+                # abort class as the projected-bytes gate, so operators
+                # see one "budget" reason for both enforcement points
+                result = self.abort(name, "budget", str(exc))
             except Exception as exc:  # noqa: BLE001 — abort, don't crash
                 result = self.abort(name, "error", repr(exc))
             finally:
@@ -333,6 +339,21 @@ class Compactor:
                 name, "budget",
                 f"projected {projected}B > {budget}B "
                 f"({self.policy.headroom_frac}x of {live_bytes}B live)",
+            )
+        # shared enforcement with the page-store ledger: a configured
+        # RAFT_TPU_PAGE_HBM_BUDGET_MB bounds the rebuild too — the shadow
+        # index's pages will reserve from the same budget at pagination
+        page_budget = default_budget()
+        if (
+            page_budget is not None
+            and getattr(mi.index, "paged", None) is not None
+            and not page_budget.would_fit(projected)
+        ):
+            return self.abort(
+                name, "budget",
+                f"projected {projected}B exceeds the page-budget remainder "
+                f"{page_budget.remaining()}B (shared "
+                "RAFT_TPU_PAGE_HBM_BUDGET_MB ledger)",
             )
 
         # ---- gather live rows (chunked main decode + captured side) -----
@@ -472,6 +493,17 @@ class Compactor:
         )
         with trace_range("serve.compact.rebuild"):
             shadow_index = self._rebuild_structure(mi, cap, all_rows)
+        src_tiered = getattr(mi.index, "paged", None)
+        if src_tiered is not None:
+            # a paged source promotes to a paged shadow at the same page
+            # size; BudgetExceeded here surfaces as a "budget" abort
+            from raft_tpu.store import paginate_index
+
+            paginate_index(
+                shadow_index,
+                page_rows=int(src_tiered.store.page_rows),
+                name=f"shadow:{mi.kind}",
+            )
         shadow = MutableIndex(
             shadow_index,
             kind=mi.kind,

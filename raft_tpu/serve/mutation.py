@@ -637,6 +637,15 @@ class MutableIndex:
                 # compacted indexes serve remapped ids; dropping the map on
                 # restore would silently re-serve dense row ids
                 arrays["main_ids"] = self._main_ids
+            tiered = getattr(self.index, "paged", None)
+            if tiered is not None:
+                # paged layout survives the roundtrip: load re-paginates at
+                # the same page size and re-warms the saved residency set
+                # (tier *placement*; slot numbers are allocator-internal)
+                scalars["paged"] = 1
+                scalars["page_rows"] = int(tiered.store.page_rows)
+                scalars["pinned"] = int(bool(tiered.stats()["pinned"]))
+                arrays["resident_pages"] = tiered.resident_pages()
             ser.save_tree(
                 path, "serve_mutable", _SERVE_SERIALIZATION_VERSION,
                 scalars, arrays,
@@ -653,6 +662,19 @@ class MutableIndex:
         )
         mod = _kind_module(scalars["kind"])
         index = mod.load(path + ".main")
+        if scalars.get("paged"):
+            from raft_tpu.store import paginate_index
+
+            tiered = paginate_index(
+                index, page_rows=int(scalars["page_rows"]),
+                name=f"load:{scalars['kind']}",
+            )
+            if int(scalars.get("pinned", 0)):
+                tiered.pin_identity()
+            else:
+                resident = np.asarray(arrays.get("resident_pages", ()))
+                if resident.size:
+                    tiered.ensure_resident(resident)
         # files written before the id map existed have no "main_ids" key —
         # they were identity-mapped by construction
         out = cls(

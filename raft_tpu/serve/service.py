@@ -189,6 +189,22 @@ class SearchService:
         """
         if not isinstance(index, (MutableIndex, ShardedIndex)):
             index = MutableIndex(index)
+        if (
+            _env.env_bool("RAFT_TPU_PAGED", False)
+            and isinstance(index, MutableIndex)
+            and getattr(index.index, "paged", None) is None
+        ):
+            # opt-in paged serving: move the main payload behind the
+            # budget-enforced page store (BudgetExceeded propagates — a
+            # misconfigured budget should fail registration loudly, not
+            # serve unpaged silently); structurally unpageable indexes
+            # (VPQ datasets, unknown kinds) keep the monolithic layout
+            from raft_tpu.store import paginate_index
+
+            try:
+                paginate_index(index.index, name=name)
+            except ValueError:
+                pass
         k = self.k if k is None else int(k)
         if self.ragged is not None and k > self.ragged.k_max:
             raise ValueError(
@@ -558,6 +574,10 @@ class SearchService:
         except Exception:  # mutation pressure gauges likewise
             pass
         try:
+            obs_cost.refresh_page_gauges(self.registry)
+        except Exception:  # page-residency gauges likewise
+            pass
+        try:
             # wasted-time fraction + measured roofline utilization per
             # executable key — pull-refreshed on the same scrape path
             obs_perf.default_ledger().refresh_gauges()
@@ -657,6 +677,9 @@ class SearchService:
                     else None
                 ),
             )
+        from raft_tpu.store.budget import default_budget
+
+        page_budget = default_budget()
         return obs_health.build_report(
             probes,
             registry=obs.default_registry(),
@@ -665,6 +688,9 @@ class SearchService:
                 if self.slo_engine is not None else None
             ),
             perf=obs_perf.default_ledger().health_slice(),
+            budget=(
+                page_budget.snapshot() if page_budget is not None else None
+            ),
         )
 
     def readyz(self) -> Dict[str, object]:

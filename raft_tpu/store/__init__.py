@@ -1,0 +1,57 @@
+"""raft_tpu.store — paged index storage with host/HBM tiering.
+
+Monolithic device arrays cap index size at HBM and force whole-buffer
+rebuilds on mutation.  This package stores the big payloads (IVF lists,
+PQ decode caches, dataset rows) as fixed-size *pages* behind an int32
+page table instead:
+
+- :mod:`~raft_tpu.store.pagestore` — host cold tier: the authoritative
+  padded page buffer, aliased back onto the index as its monolithic
+  host view (serialization and compaction decode paths are unchanged).
+- :mod:`~raft_tpu.store.tiered` — the HBM hot pool: a static device
+  array + device page table with clock eviction, demand admission
+  (``ensure_resident``) and bounded async prefetch keyed by the
+  coarse-probe result.  Page movement rewrites values, never shapes —
+  zero recompiles after warmup.
+- :mod:`~raft_tpu.store.budget` — hard memory admission: reservations
+  either fit ``RAFT_TPU_PAGE_HBM_BUDGET_MB`` or raise a loud
+  :class:`BudgetExceeded`; the compactor's projected-bytes gate and
+  serving share this one ledger.
+- :mod:`~raft_tpu.store.paged` — jit-traversable paged views
+  (:class:`PagedLists` / :class:`PagedRows`) that substitute for the
+  monolithic payload inside the existing search executables, plus
+  :func:`paginate_index` to convert a built index in place.
+
+Enable per-service with ``RAFT_TPU_PAGED=1`` (the unpaged path is the
+default-off control arm); see ``docs/paged_storage.md``.
+"""
+
+from raft_tpu.store.budget import (
+    BudgetExceeded,
+    MemoryBudget,
+    default_budget,
+    set_default_budget,
+)
+from raft_tpu.store.paged import (
+    PagedLists,
+    PagedRows,
+    gather_lists,
+    pages_for_lists,
+    paginate_index,
+)
+from raft_tpu.store.pagestore import PageStore
+from raft_tpu.store.tiered import TieredStore
+
+__all__ = [
+    "BudgetExceeded",
+    "MemoryBudget",
+    "PageStore",
+    "PagedLists",
+    "PagedRows",
+    "TieredStore",
+    "default_budget",
+    "gather_lists",
+    "pages_for_lists",
+    "paginate_index",
+    "set_default_budget",
+]

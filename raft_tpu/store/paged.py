@@ -1,0 +1,289 @@
+"""Paged device views + per-backend pagination.
+
+Two jit-traversable pytrees substitute for the monolithic device payload
+inside the *existing* search executables:
+
+- :class:`PagedLists` stands in for a padded-list tensor
+  ``[L, cap, payload]`` (ivf_flat ``list_data``, ivf_pq's decoded scan
+  cache).  ``gather_lists(ld, pp)`` replaces the ``ld[pp]`` gather: for
+  a paged view it routes each probe through the device page table
+  (``pool[page_slot[list*ppl + j]]``), producing rows bit-identical to
+  the monolithic gather for resident pages — everything downstream of
+  the gather is unchanged, which is what makes paged search
+  result-identical to the control arm.
+- :class:`PagedRows` stands in for a flat row matrix ``[n, d]`` (cagra
+  dataset); ``decode(ids)`` is the page-table translation of a row
+  gather and slots straight into cagra's existing ``_gather_rows``
+  decode branch.
+
+:func:`paginate_index` converts a built backend index *in place*: the
+big payload moves to a host :class:`~raft_tpu.store.pagestore.PageStore`
+(cold tier, aliased back onto the index as its monolithic host array so
+serialization / compaction decode paths are unchanged) fronted by a
+budget-sized :class:`~raft_tpu.store.tiered.TieredStore` hot pool at
+``index.paged``.  List capacity is repadded to a page multiple with the
+build's own padding values (ids −1, norms +inf, rows 0), so the extra
+slots lose every select_k exactly like build padding does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import env as _env
+from raft_tpu.core.logger import logger as _log
+from raft_tpu.store.budget import MemoryBudget, default_budget
+from raft_tpu.store.pagestore import PageStore
+from raft_tpu.store.tiered import TieredStore
+
+__all__ = [
+    "PagedLists",
+    "PagedRows",
+    "gather_lists",
+    "pages_for_lists",
+    "paginate_index",
+    "default_page_rows",
+]
+
+#: backends paginate_index understands (module basename of the Index type)
+PAGED_KINDS = ("ivf_flat", "ivf_pq", "brute_force", "cagra")
+
+
+def default_page_rows() -> int:
+    return int(_env.env_int("RAFT_TPU_PAGE_ROWS", 1024))
+
+
+class PagedLists:
+    """Device view of a paged ``[L, cap, payload]`` padded-list tensor.
+
+    Children: ``pool [slots, page_rows, payload]``, ``page_slot
+    [L * pages_per_list] int32``.  ``shape`` / ``dtype`` mirror the
+    monolithic tensor so call sites that read them stay untouched.
+    """
+
+    def __init__(self, pool, page_slot, pages_per_list: int):
+        self.pool = pool
+        self.page_slot = page_slot
+        self.pages_per_list = int(pages_per_list)
+
+    @property
+    def shape(self):
+        ppl = self.pages_per_list
+        return (
+            self.page_slot.shape[0] // ppl,
+            ppl * self.pool.shape[1],
+        ) + tuple(self.pool.shape[2:])
+
+    @property
+    def dtype(self):
+        return self.pool.dtype
+
+    @property
+    def page_rows(self) -> int:
+        return self.pool.shape[1]
+
+    def tree_flatten(self):
+        return (self.pool, self.page_slot), (self.pages_per_list,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = object.__new__(cls)
+        obj.pool, obj.page_slot = children
+        obj.pages_per_list = aux[0]
+        return obj
+
+
+class PagedRows:
+    """Device view of a paged flat row matrix ``[n, d]`` with a
+    ``decode(ids) -> f32 rows`` page-table gather (cagra's
+    ``_gather_rows`` contract for non-dense datasets)."""
+
+    def __init__(self, pool, page_slot, n_rows: int):
+        self.pool = pool
+        self.page_slot = page_slot
+        self.n_rows = int(n_rows)
+
+    @property
+    def shape(self):
+        return (self.n_rows,) + tuple(self.pool.shape[2:])
+
+    @property
+    def dtype(self):
+        return self.pool.dtype
+
+    @property
+    def page_rows(self) -> int:
+        return self.pool.shape[1]
+
+    def decode(self, ids):
+        """Rows for ``ids`` (clipped like the dense gather), upcast f32."""
+        pr = self.pool.shape[1]
+        ids = jnp.clip(ids, 0, self.n_rows - 1)
+        page = ids // pr
+        return self.pool[self.page_slot[page], ids - page * pr].astype(
+            jnp.float32
+        )
+
+    def tree_flatten(self):
+        return (self.pool, self.page_slot), (self.n_rows,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = object.__new__(cls)
+        obj.pool, obj.page_slot = children
+        obj.n_rows = aux[0]
+        return obj
+
+
+jax.tree_util.register_pytree_node(
+    PagedLists, PagedLists.tree_flatten, PagedLists.tree_unflatten
+)
+jax.tree_util.register_pytree_node(
+    PagedRows, PagedRows.tree_flatten, PagedRows.tree_unflatten
+)
+
+
+def gather_lists(list_data, pp):
+    """``list_data[pp]`` with page-table indirection when paged.
+
+    ``pp`` is any int array of list ids; the result appends
+    ``(cap, payload...)`` to its shape, exactly like the monolithic
+    gather.  Non-resident pages read through a wrapped slot index
+    (in-bounds, garbage values) — callers uphold the residency contract
+    via ``TieredStore.ensure_resident`` before dispatch, and padding
+    probes are masked downstream by the ids/q2 invalid masks.
+    """
+    if isinstance(list_data, PagedLists):
+        ppl = list_data.pages_per_list
+        pages = pp[..., None] * ppl + jnp.arange(ppl, dtype=jnp.int32)
+        rows = list_data.pool[list_data.page_slot[pages]]
+        return rows.reshape(tuple(pp.shape) + tuple(list_data.shape[1:]))
+    return list_data[pp]
+
+
+def pages_for_lists(lists: np.ndarray, pages_per_list: int) -> np.ndarray:
+    """The page ids covering ``lists`` (host-side prefetch keying)."""
+    lists = np.asarray(lists, np.int64).reshape(-1)  # raft-tpu: ignore[HOSTSYNC] host-side page-id arithmetic on an already-host list set
+    return (
+        lists[:, None] * pages_per_list + np.arange(pages_per_list)
+    ).ravel()
+
+
+# -- pagination ---------------------------------------------------------------
+def _kind_of(index) -> str:
+    return type(index).__module__.rsplit(".", 1)[-1]
+
+
+def _repad(arr: np.ndarray, cap2: int, fill) -> np.ndarray:
+    """Grow axis 1 (list capacity) to ``cap2`` with ``fill``."""
+    L, cap = arr.shape[:2]
+    if cap == cap2:
+        return arr
+    out = np.full((L, cap2) + arr.shape[2:], fill, arr.dtype)
+    out[:, :cap] = arr
+    return out
+
+
+def _paginate_lists(
+    index, page_rows: int, name: str, budget: Optional[MemoryBudget],
+    *, y2_attr: str, y2_fill,
+) -> TieredStore:
+    """Shared IVF pagination: page ``list_data``, repad the per-slot
+    sidecars to the page-aligned capacity, alias the cold tier back as
+    the monolithic host view."""
+    ld = np.asarray(index.list_data)
+    L, cap = ld.shape[:2]
+    ppl = max(1, -(-cap // page_rows))
+    cap2 = ppl * page_rows
+    ld = _repad(ld, cap2, 0)
+    li = _repad(np.asarray(index.list_index), cap2, -1)
+    y2 = _repad(np.asarray(getattr(index, y2_attr)), cap2, y2_fill)
+
+    store = PageStore(ld.reshape((L * cap2,) + ld.shape[2:]), page_rows)
+    tiered = TieredStore(store, name=name, budget=budget)
+    tiered.pages_per_list = ppl
+    index.list_data = store.data.reshape((L, cap2) + ld.shape[2:])
+    index.list_index = jnp.asarray(li)
+    setattr(index, y2_attr, jnp.asarray(y2))
+    index.paged = tiered
+    return tiered
+
+
+def _paginate_rows(
+    index, rows: np.ndarray, page_rows: int, name: str,
+    budget: Optional[MemoryBudget],
+) -> TieredStore:
+    store = PageStore(rows, page_rows)
+    tiered = TieredStore(store, name=name, budget=budget)
+    index.dataset = store.data[: rows.shape[0]]
+    index.paged = tiered
+    return tiered
+
+
+def paginate_index(
+    index,
+    *,
+    page_rows: Optional[int] = None,
+    budget: Optional[MemoryBudget] = "default",  # type: ignore[assignment]
+    name: str = "index",
+) -> TieredStore:
+    """Convert a built backend index to paged storage in place.
+
+    The payload tensor moves to host pages (cold tier, authoritative —
+    save/compaction decode paths read it unchanged) behind a
+    budget-sized HBM hot pool at ``index.paged``.  Idempotent.
+
+    brute_force/cagra scan arbitrary rows per dispatch, so their whole
+    payload must fit the hot pool (identity-pinned / fully resident at
+    first search; ``BudgetExceeded`` otherwise).  The IVF backends scan
+    only the coarse-probed lists' pages and serve payloads larger than
+    the hot pool.
+    """
+    if getattr(index, "paged", None) is not None:
+        return index.paged
+    kind = _kind_of(index)
+    if kind not in PAGED_KINDS:
+        raise ValueError(
+            f"paginate_index: unsupported index kind {kind!r} "
+            f"(supported: {PAGED_KINDS})"
+        )
+    pr = int(page_rows) if page_rows else default_page_rows()
+    if pr < 8 or pr % 8:
+        raise ValueError(
+            f"page_rows must be a positive multiple of 8 (TPU sublane), "
+            f"got {pr}"
+        )
+    if budget == "default":
+        budget = default_budget()
+
+    if kind == "ivf_flat":
+        tiered = _paginate_lists(
+            index, pr, name, budget, y2_attr="list_norms", y2_fill=np.inf
+        )
+    elif kind == "ivf_pq":
+        ld = np.asarray(index.list_data)
+        cap = ld.shape[1]
+        ppl = max(1, -(-cap // pr))
+        # codes ride the cold tier only: they are not on the scan path
+        # (the decoded list_data cache is) — host numpy keeps HBM clean
+        index.list_codes = _repad(np.asarray(index.list_codes), ppl * pr, 0)
+        tiered = _paginate_lists(
+            index, pr, name, budget, y2_attr="list_y2", y2_fill=0.0
+        )
+    else:  # brute_force / cagra: flat dataset rows
+        ds = getattr(index, "dataset", None)
+        if ds is None or getattr(ds, "ndim", 0) != 2:
+            raise ValueError(
+                f"paginate_index: {kind} index has no dense [n, d] dataset "
+                "to page (VPQ/dataset-free indexes stay monolithic)"
+            )
+        tiered = _paginate_rows(index, np.asarray(ds), pr, name, budget)
+    _log.debug(
+        "paginate_index: kind=%s name=%s pages=%d page_rows=%d slots=%d",
+        kind, name, tiered.n_pages, pr, tiered.slots,
+    )
+    return tiered

@@ -1,0 +1,86 @@
+"""Fixed-size page layout over a host row array.
+
+The "Ragged Paged Attention" recipe (PAPERS.md): ragged per-entity state
+(here: IVF lists, PQ decode caches, dataset rows) is stored as fixed-
+size pages addressed through an int32 page table, so residency and
+movement operate on uniform blocks instead of per-list ragged buffers.
+
+A :class:`PageStore` is the *cold tier*: host-RAM pages that remain the
+authoritative copy of every row.  It owns one contiguous padded buffer;
+``pages`` and the flat ``data`` array are reshaped views of the same
+memory, so an index can keep its familiar monolithic host view (e.g.
+``list_data [L, cap, d]``) aliased onto the paged layout with zero copy
+and zero double-counting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["PageStore"]
+
+
+class PageStore:
+    """Host pages over ``rows [n, ...]`` with ``page_rows`` rows/page.
+
+    Attributes
+    ----------
+    data : np.ndarray
+        ``[n_pages * page_rows, ...]`` — the padded flat buffer (rows
+        past ``n_rows`` are zeros).  Views of this buffer are what the
+        owning index aliases as its monolithic host arrays.
+    pages : np.ndarray
+        ``[n_pages, page_rows, ...]`` — reshaped view of ``data``.
+    page_table : np.ndarray
+        ``[n_pages] int32`` logical→storage page map.  Identity today;
+        serialized so a future compacting writer can relocate pages
+        without touching logical addresses.
+    """
+
+    def __init__(self, rows: np.ndarray, page_rows: int):
+        rows = np.asarray(rows)
+        if rows.ndim < 1:
+            raise ValueError("rows must have at least one dimension")
+        if page_rows < 1:
+            raise ValueError(f"page_rows must be >= 1, got {page_rows}")
+        n = rows.shape[0]
+        self.n_rows = int(n)
+        self.page_rows = int(page_rows)
+        n_pages = max(1, -(-n // page_rows))
+        payload = rows.shape[1:]
+        self.data = np.zeros((n_pages * page_rows,) + payload, rows.dtype)
+        self.data[:n] = rows
+        self.pages = self.data.reshape((n_pages, page_rows) + payload)
+        self.page_table = np.arange(n_pages, dtype=np.int32)
+
+    @property
+    def n_pages(self) -> int:
+        return self.pages.shape[0]
+
+    @property
+    def page_bytes(self) -> int:
+        return int(self.pages[0].nbytes)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.nbytes) + int(self.page_table.nbytes)
+
+    def page(self, i: int) -> np.ndarray:
+        """One logical page's rows (a view, page-table indirected)."""
+        return self.pages[self.page_table[i]]
+
+    def gather(self, page_ids: np.ndarray) -> np.ndarray:
+        """Rows of several logical pages, ``[len(page_ids), page_rows, ...]``."""
+        return self.pages[self.page_table[np.asarray(page_ids, np.int64)]]
+
+    def to_array(self) -> np.ndarray:
+        """The original (unpadded) rows — a view when the page table is
+        identity, a gathered copy after relocation."""
+        if np.array_equal(self.page_table, np.arange(self.n_pages)):
+            return self.data[: self.n_rows]
+        flat = self.pages[self.page_table].reshape(self.data.shape)
+        return flat[: self.n_rows]

@@ -1,0 +1,463 @@
+"""Two-tier page residency: HBM hot pool over host cold pages.
+
+The hot tier is ONE static device array ``pool [slots, page_rows, ...]``
+plus a device page table ``page_slot [n_pages] int32`` (−1 = not
+resident).  Page movement rewrites *values* through two shape-bucketed
+jitted scatters — shapes never change, so a warmed serving process pays
+zero recompiles no matter how pages migrate (the recompile-tier
+discipline of the padded-list layout, extended to residency).
+
+Residency is demand-driven and clock-evicted:
+
+- :meth:`ensure_resident` — blocking admission: the caller's pages are
+  resident when it returns (search dispatch calls it with the pages of
+  the coarse-probed lists).  Counts prefetch hits/misses.
+- :meth:`prefetch` — async warm-start: a bounded daemon queue
+  (``RAFT_TPU_PAGE_PREFETCH_DEPTH``) fetches pages off the caller's
+  thread; a full queue drops the hint (prefetch is advisory).
+- :meth:`evict` — clock (second-chance) victim selection over slots;
+  runs implicitly when admission needs room.  An evict-then-refetch
+  inside the thrash window publishes a rate-limited ``page_thrash``
+  bus event — the operator signal that the hot pool is undersized.
+
+Snapshot isolation rides jax's functional updates: a view captured via
+:meth:`view` references the pool buffers of that moment; later fetches
+build *new* arrays (no donation), so in-flight searches never observe a
+page swap mid-dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import env as _env
+from raft_tpu.core.logger import logger as _log
+from raft_tpu.core.trace import traced
+from raft_tpu.store.budget import BudgetExceeded, MemoryBudget
+from raft_tpu.store.pagestore import PageStore
+
+__all__ = ["TieredStore"]
+
+#: fetches within this many admissions of the eviction count as thrash
+_THRASH_WINDOW = 256
+#: minimum seconds between page_thrash events per store
+_THRASH_DEBOUNCE_S = 5.0
+
+
+@jax.jit
+def _pool_write(pool, slots, rows):
+    """Scatter fetched pages into their slots (functional: new pool)."""
+    return pool.at[slots].set(rows)
+
+
+@jax.jit
+def _slot_write(page_slot, pages, slots):
+    """Rewrite page→slot entries (evictions ride as −1 values)."""
+    return page_slot.at[pages].set(slots)
+
+
+def _pow2(n: int) -> int:
+    """Fetch-batch shape bucket: power of two ≥ n (bounds the distinct
+    scatter shapes at O(log n_pages) executables)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class TieredStore:
+    """HBM hot pool + host cold tier over one :class:`PageStore`."""
+
+    def __init__(
+        self,
+        store: PageStore,
+        *,
+        name: str = "index",
+        budget: Optional[MemoryBudget] = None,
+        max_slots: Optional[int] = None,
+        prefetch_depth: Optional[int] = None,
+    ):
+        self.store = store
+        self.name = name
+        self.page_rows = store.page_rows
+        n_pages = store.n_pages
+        page_bytes = store.page_bytes
+        slots = n_pages if max_slots is None else min(n_pages, int(max_slots))
+
+        self._budget = budget
+        self._budget_key = f"pager:{name}:{uuid.uuid4().hex[:8]}"
+        if budget is not None:
+            # size the pool to what the budget grants (hard admission):
+            # page_slot + pool bytes charge the ledger together
+            affordable = (budget.remaining() - 4 * n_pages) // max(page_bytes, 1)
+            slots = min(slots, int(affordable))
+            if slots < 1:
+                raise BudgetExceeded(
+                    f"pager {name!r}: budget cannot hold a single "
+                    f"{page_bytes}B page (remaining "
+                    f"{budget.remaining()}B of {budget.limit_bytes}B)"
+                )
+            budget.reserve(self._budget_key, slots * page_bytes + 4 * n_pages)
+            # release on GC so a dropped index returns its budget even
+            # without an explicit close()
+            self._finalizer = weakref.finalize(
+                self, budget.release, self._budget_key
+            )
+        self.slots = slots
+
+        payload = store.pages.shape[2:]
+        self.pool = jnp.zeros((slots, store.page_rows) + payload, store.dtype)
+        self.page_slot = jnp.full((n_pages,), -1, jnp.int32)
+
+        # host mirrors (the device arrays are never read back)
+        self._resident = np.full(n_pages, -1, np.int32)   # page -> slot
+        self._slot_page = np.full(slots, -1, np.int32)    # slot -> page
+        self._ref = np.zeros(slots, bool)                 # clock ref bits
+        self._hand = 0
+        self._free = list(range(slots))
+        self._pinned = False
+        self._lock = threading.RLock()
+
+        # counters (mirrored into the obs registry on every bump)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.prefetched = 0
+        self.thrash = 0
+        self._fetch_seq = 0
+        self._evicted_at: Dict[int, int] = {}
+        self._last_thrash_t = -1e9
+
+        depth = prefetch_depth
+        if depth is None:
+            depth = _env.env_int("RAFT_TPU_PAGE_PREFETCH_DEPTH", 2)
+        self._prefetch_q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._prefetch_thread: Optional[threading.Thread] = None
+
+    # -- sizing --------------------------------------------------------------
+    @property
+    def n_pages(self) -> int:
+        return self.store.n_pages
+
+    @property
+    def resident_count(self) -> int:
+        with self._lock:
+            return int((self._resident >= 0).sum())
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes of the hot tier (pool + device page table)."""
+        return int(self.pool.nbytes) + int(self.page_slot.nbytes)
+
+    def close(self) -> None:
+        """Release the budget reservation early (idempotent)."""
+        if self._budget is not None:
+            self._budget.release(self._budget_key)
+
+    # -- residency -----------------------------------------------------------
+    def _normalize(self, pages) -> np.ndarray:
+        arr = np.unique(np.asarray(pages, np.int64).ravel())
+        return arr[(arr >= 0) & (arr < self.n_pages)]
+
+    @traced("store.pager.ensure")
+    def ensure_resident(self, pages: Sequence[int]) -> None:
+        """Blocking admission: every listed page is resident on return.
+
+        Raises :class:`BudgetExceeded` when the request alone exceeds
+        the hot pool — the loud alternative to thrashing every dispatch.
+        """
+        pages = self._normalize(pages)
+        if pages.size == 0:
+            return
+        with self._lock:
+            slot_of = self._resident[pages]
+            present = slot_of >= 0
+            hits = int(present.sum())
+            missing = pages[~present]
+            self.hits += hits
+            if hits:
+                self._ref[slot_of[present]] = True
+                self._counter("raft_tpu_page_hits_total", hits)
+            if missing.size == 0:
+                return
+            if pages.size > self.slots:
+                raise BudgetExceeded(
+                    f"pager {self.name!r}: {pages.size} pages requested "
+                    f"but the hot pool holds {self.slots} "
+                    f"(page_rows={self.page_rows}); raise "
+                    "RAFT_TPU_PAGE_HBM_BUDGET_MB or RAFT_TPU_PAGE_ROWS"
+                )
+            self.misses += missing.size
+            self._counter("raft_tpu_page_misses_total", int(missing.size))
+            # pages of THIS admission may not be victimized mid-batch —
+            # the clock's second sweep would otherwise evict a page the
+            # caller was just promised (ref bits only survive one wrap)
+            protected = np.zeros(self.slots, bool)
+            protected[slot_of[present]] = True
+            self._fetch(missing, protected)
+
+    @traced("store.pager.prefetch")
+    def prefetch(self, pages: Sequence[int]) -> bool:
+        """Async warm-start keyed by the coarse-probe result.  Returns
+        whether the hint was accepted (a full queue drops it)."""
+        pages = self._normalize(pages)
+        if pages.size == 0:
+            return True
+        with self._lock:
+            pages = pages[self._resident[pages] < 0]
+        if pages.size == 0:
+            return True
+        self._ensure_worker()
+        try:
+            self._prefetch_q.put_nowait(pages)
+            return True
+        except queue.Full:
+            return False
+
+    @traced("store.pager.evict")
+    def evict(self, count: int = 1) -> List[int]:
+        """Clock-evict up to ``count`` pages; returns the evicted page
+        ids.  Pinned stores refuse (their views alias slot order)."""
+        with self._lock:
+            if self._pinned:
+                raise RuntimeError(
+                    f"pager {self.name!r} is pinned (identity placement); "
+                    "eviction would corrupt aliased views"
+                )
+            evicted: List[int] = []
+            occupied = int((self._slot_page >= 0).sum())
+            for _ in range(min(count, occupied)):
+                slot = self._clock_victim()
+                if slot is None:
+                    break
+                evicted.append(self._evict_slot(slot))
+                self._free.append(slot)
+            if evicted:
+                self._flush_slot_writes(
+                    np.asarray(evicted, np.int64),
+                    np.full(len(evicted), -1, np.int32),
+                )
+            return evicted
+
+    def pin_identity(self) -> None:
+        """Upload every page into its identity slot (slot i holds page
+        i) in one transfer.  After pinning, ``pool.reshape(-1, ...)`` is
+        bitwise the padded flat host array — the zero-overhead placement
+        brute_force/cagra views rely on.  Requires a full-size pool."""
+        with self._lock:
+            if self._pinned:
+                return
+            if self.slots < self.n_pages:
+                raise BudgetExceeded(
+                    f"pager {self.name!r}: identity pinning needs "
+                    f"{self.n_pages} slots, pool holds {self.slots}; this "
+                    "backend requires the whole payload resident — raise "
+                    "RAFT_TPU_PAGE_HBM_BUDGET_MB"
+                )
+            self.misses += self.n_pages
+            self._counter("raft_tpu_page_misses_total", self.n_pages)
+            self.pool = jnp.asarray(self.store.pages[self.store.page_table])
+            self.page_slot = jnp.arange(self.n_pages, dtype=jnp.int32)
+            self._resident = np.arange(self.n_pages, dtype=np.int32)
+            self._slot_page = np.arange(self.slots, dtype=np.int32)
+            self._ref[:] = True
+            self._free = []
+            self._pinned = True
+
+    def view(self) -> Tuple[jax.Array, jax.Array]:
+        """Snapshot of (pool, page_slot) — consistent by construction
+        (both are replaced together under the lock)."""
+        with self._lock:
+            return self.pool, self.page_slot
+
+    def resident_pages(self) -> np.ndarray:
+        """Resident page ids ordered by slot (serialization: replaying
+        ``ensure_resident`` over this restores the placement)."""
+        with self._lock:
+            order = np.argsort(self._resident[self._resident >= 0])
+            pages = np.flatnonzero(self._resident >= 0).astype(np.int32)
+            return pages[order]
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            resident = int((self._resident >= 0).sum())
+            return {
+                "name": self.name,
+                "n_pages": self.n_pages,
+                "slots": self.slots,
+                "page_rows": self.page_rows,
+                "resident": resident,
+                "host_only": self.n_pages - resident,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "prefetched": self.prefetched,
+                "thrash": self.thrash,
+                "pinned": self._pinned,
+                "hot_bytes": self.nbytes,
+                "cold_bytes": self.store.nbytes,
+            }
+
+    # -- internals (lock held) -----------------------------------------------
+    def _fetch(
+        self, missing: np.ndarray, protected: Optional[np.ndarray] = None
+    ) -> None:
+        """Admit ``missing`` pages (none currently resident).
+        ``protected`` slots (the admission's hit pages) are never
+        victimized; slots claimed here join the protected set."""
+        if protected is None:
+            protected = np.zeros(self.slots, bool)
+        slots = np.empty(missing.size, np.int32)
+        evicted: List[int] = []
+        for i, page in enumerate(missing):
+            if self._free:
+                slot = self._free.pop()
+            else:
+                slot = self._clock_victim(protected)
+                if slot is None:  # pragma: no cover - guarded by caller
+                    raise BudgetExceeded(
+                        f"pager {self.name!r}: no evictable slot "
+                        f"(slots={self.slots})"
+                    )
+                evicted.append(self._evict_slot(slot))
+            slots[i] = slot
+            protected[slot] = True
+            self._slot_page[slot] = page
+            self._resident[page] = slot
+            self._ref[slot] = True
+        self._fetch_seq += missing.size
+        self._note_thrash(missing)
+
+        rows = self.store.gather(missing)
+        B = _pow2(missing.size)
+        pad = B - missing.size
+        if pad:
+            # duplicate scatter indices writing identical values are a
+            # well-defined no-op — padding repeats the first entry
+            slots = np.concatenate([slots, np.repeat(slots[:1], pad)])
+            rows = np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)])
+        self.pool = _pool_write(  # raft-tpu: ignore[LOCKORDER] every caller (ensure_resident / _prefetch_loop) holds self._lock
+            self.pool, jnp.asarray(slots), jnp.asarray(rows)
+        )
+        idx = np.concatenate([np.asarray(evicted, np.int64), missing])
+        val = np.concatenate(
+            [np.full(len(evicted), -1, np.int32), slots[: missing.size]]
+        )
+        self._flush_slot_writes(idx, val)
+
+    def _flush_slot_writes(self, pages: np.ndarray, slots: np.ndarray) -> None:
+        B = _pow2(max(1, pages.size))
+        pad = B - pages.size
+        if pad:
+            pages = np.concatenate([pages, np.repeat(pages[:1], pad)])
+            slots = np.concatenate([slots, np.repeat(slots[:1], pad)])
+        self.page_slot = _slot_write(  # raft-tpu: ignore[LOCKORDER] callers (_fetch / evict) hold self._lock
+            self.page_slot,
+            jnp.asarray(pages, jnp.int32),
+            jnp.asarray(slots, jnp.int32),
+        )
+
+    def _clock_victim(
+        self, protected: Optional[np.ndarray] = None
+    ) -> Optional[int]:
+        """Second-chance sweep: clear ref bits until an unreferenced,
+        unprotected occupied slot comes around."""
+        for _ in range(3 * self.slots):
+            slot = self._hand
+            self._hand = (self._hand + 1) % self.slots
+            if self._slot_page[slot] < 0:
+                continue
+            if protected is not None and protected[slot]:
+                continue
+            if self._ref[slot]:
+                self._ref[slot] = False
+                continue
+            return slot
+        return None
+
+    def _evict_slot(self, slot: int) -> int:
+        page = int(self._slot_page[slot])
+        self._slot_page[slot] = -1
+        self._resident[page] = -1
+        self._ref[slot] = False
+        self._evicted_at[page] = self._fetch_seq
+        self.evictions += 1
+        self._counter("raft_tpu_page_evictions_total", 1)
+        return page
+
+    def _note_thrash(self, fetched: np.ndarray) -> None:
+        """Evict-then-refetch inside the window = the pool is too small
+        for the working set; publish (debounced) so it lands in the
+        incident stream instead of only a counter."""
+        n = 0
+        for page in fetched:
+            seq = self._evicted_at.pop(int(page), None)
+            if seq is not None and self._fetch_seq - seq <= _THRASH_WINDOW:
+                n += 1
+        if not n:
+            return
+        self.thrash += n
+        now = time.monotonic()
+        if now - self._last_thrash_t < _THRASH_DEBOUNCE_S:
+            return
+        self._last_thrash_t = now
+        try:
+            from raft_tpu.obs import events as _events
+
+            _events.publish(
+                "page_thrash",
+                f"pager {self.name!r}: {n} pages refetched within "
+                f"{_THRASH_WINDOW} admissions of eviction "
+                f"(slots={self.slots}, pages={self.n_pages})",
+                index=self.name,
+                pages=int(n),
+                slots=int(self.slots),
+                n_pages=int(self.n_pages),
+            )
+        except Exception:  # pragma: no cover - obs must never break serving
+            _log.debug("page_thrash publish failed", exc_info=True)
+
+    def _counter(self, name: str, value: int) -> None:
+        try:
+            from raft_tpu.obs import registry as _registry
+
+            _registry.default_registry().counter(name).inc(
+                float(value), index=self.name
+            )
+        except Exception:  # pragma: no cover
+            pass
+
+    # -- async prefetch ------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._prefetch_thread is not None and self._prefetch_thread.is_alive():
+            return
+        t = threading.Thread(
+            target=self._prefetch_loop,
+            name=f"raft-tpu-pager-{self.name}",
+            daemon=True,
+        )
+        self._prefetch_thread = t
+        t.start()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            pages = self._prefetch_q.get()
+            try:
+                with self._lock:
+                    missing = pages[self._resident[pages] < 0]
+                    if missing.size and missing.size <= self.slots:
+                        self._fetch(missing)
+                        self.prefetched += missing.size
+            except Exception:  # pragma: no cover - advisory path
+                _log.debug("async prefetch failed", exc_info=True)
+            finally:
+                self._prefetch_q.task_done()

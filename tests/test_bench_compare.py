@@ -664,6 +664,55 @@ def test_kernels_smoke_against_frozen_record(tmp_path):
 
 
 @pytest.mark.slow
+def test_paged_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the paged-storage A/B: run ``bench.py paged`` (mono
+    vs HBM-resident paged vs over-budget paged over the same ivf_flat
+    build) and gate it with ``bench.py compare`` against the frozen
+    record.  The leg self-asserts identical ids across all three arms
+    and the per-arm recompile bounds; here we re-check the emitted
+    line's contract: the resident arm within the ≤10% acceptance
+    overhead (plus CI scheduling noise), the over-budget arm actually
+    over budget (slots < pages) yet serving, with its demand paging
+    visible in the eviction counters."""
+    candidate = str(tmp_path / "paged_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "paged"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["ids_identical"] is True
+    assert line["recompiles"] <= 4, "paged leg recompiled on the hot path"
+    arms = line["arms"]
+    assert arms["mono"]["recompiles"] == 0
+    assert arms["paged_resident"]["recompiles"] == 0
+    # acceptance bar ≤10%; single-core CI scheduling noise rides on top
+    assert line["resident_overhead_pct"] <= 15.0, (
+        f"HBM-resident paged overhead out of tolerance: "
+        f"{line['resident_overhead_pct']}%"
+    )
+    over = arms["paged_overbudget"]
+    assert over["slots"] < over["pages"], "over-budget arm was not over budget"
+    assert over["qps"] > 0
+    assert over["evictions"] > 0 and over["misses"] > 0, (
+        "over-budget arm never paged — the pool silently fit everything"
+    )
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_paged_r17.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
 def test_distributed_build_smoke_against_frozen_record(tmp_path):
     """CI smoke for the distributed-build A/B: run ``bench.py build``
     (single-host ivf_flat.build vs build_sharded over 8 forced host

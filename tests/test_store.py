@@ -1,0 +1,272 @@
+"""Unit contracts for ``raft_tpu.store`` — the paged-storage tentpole's
+building blocks, tested in isolation from the index backends:
+
+* :class:`MemoryBudget` — hard all-or-nothing admission, named-owner
+  ledger, loud :class:`BudgetExceeded` with the snapshot in the message;
+* :class:`PageStore` — the cold tier: padded flat buffer, ``pages`` and
+  ``data`` as views of the same memory (zero copy / zero double-count),
+  page-table-indirected reads;
+* :class:`TieredStore` — the HBM hot pool: demand admission, clock
+  eviction with in-admission protection, thrash detection, async
+  prefetch, identity pinning, and budget-sized slots.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.store import (
+    BudgetExceeded,
+    MemoryBudget,
+    PageStore,
+    TieredStore,
+    default_budget,
+    set_default_budget,
+)
+
+# ---------------------------------------------------------------------------
+# MemoryBudget
+
+
+def test_budget_reserve_release_roundtrip():
+    b = MemoryBudget(1000)
+    b.reserve("a", 400)
+    b.reserve("b", 300)
+    assert b.reserved() == 700
+    assert b.remaining() == 300
+    assert b.would_fit(300) and not b.would_fit(301)
+    b.release("a", 100)             # partial shrink
+    assert b.reserved() == 600
+    b.release("a")                  # drop the rest
+    assert b.reserved() == 300
+    b.release("nope")               # unknown owner: no-op (finalizers)
+    assert b.reserved() == 300
+
+
+def test_budget_reserve_is_all_or_nothing():
+    b = MemoryBudget(100)
+    b.reserve("a", 60)
+    with pytest.raises(BudgetExceeded) as exc:
+        b.reserve("b", 50)
+    # the message carries the ledger so the operator sees WHO holds it
+    assert "'a': 60" in str(exc.value)
+    assert "40B of 100B remaining" in str(exc.value)
+    # the failed reservation must not have partially landed
+    assert b.reserved() == 60
+    b.reserve("b", 40)              # exact fit is granted
+
+
+def test_budget_rejects_bad_args():
+    with pytest.raises(ValueError):
+        MemoryBudget(0)
+    b = MemoryBudget(10)
+    with pytest.raises(ValueError):
+        b.reserve("a", -1)
+
+
+def test_budget_snapshot_is_json_shape():
+    b = MemoryBudget(200)
+    b.reserve("pool", 50)
+    snap = b.snapshot()
+    assert snap == {
+        "limit_bytes": 200,
+        "reserved_bytes": 50,
+        "remaining_bytes": 150,
+        "utilization": 0.25,
+        "owners": {"pool": 50},
+    }
+
+
+def test_default_budget_swap_and_restore():
+    mine = MemoryBudget(123)
+    prev = set_default_budget(mine)
+    try:
+        assert default_budget() is mine
+    finally:
+        set_default_budget(prev)
+    assert default_budget() is prev
+
+
+# ---------------------------------------------------------------------------
+# PageStore
+
+
+def test_pagestore_layout_and_views():
+    rows = np.arange(10 * 3, dtype=np.float32).reshape(10, 3)
+    ps = PageStore(rows, page_rows=4)
+    assert ps.n_pages == 3                      # ceil(10/4)
+    assert ps.data.shape == (12, 3)             # padded flat buffer
+    assert ps.pages.shape == (3, 4, 3)
+    np.testing.assert_array_equal(ps.data[:10], rows)
+    assert not ps.data[10:].any()               # padding is zeros
+    # pages/data are views of ONE buffer: a write through either shows
+    # through the other (this is what lets the index alias its
+    # monolithic host array onto the paged layout with no double-count)
+    ps.pages[1, 0, 0] = 99.0
+    assert ps.data[4, 0] == 99.0
+    assert ps.page_bytes == 4 * 3 * 4
+    assert ps.nbytes == ps.data.nbytes + ps.page_table.nbytes
+
+
+def test_pagestore_gather_and_to_array():
+    rows = np.arange(20, dtype=np.int32).reshape(10, 2)
+    ps = PageStore(rows, page_rows=4)
+    np.testing.assert_array_equal(ps.page(1), ps.pages[1])
+    g = ps.gather([2, 0])
+    np.testing.assert_array_equal(g[0], ps.pages[2])
+    np.testing.assert_array_equal(g[1], ps.pages[0])
+    # identity page table → to_array is a view of the original rows
+    out = ps.to_array()
+    np.testing.assert_array_equal(out, rows)
+    assert out.base is ps.data
+    # after a relocation the gather path reassembles the rows
+    ps2 = PageStore(rows, page_rows=5)          # 2 pages, no padding
+    ps2.page_table = ps2.page_table[::-1].copy()
+    ps2.pages[:] = ps2.pages[::-1].copy()
+    np.testing.assert_array_equal(ps2.to_array(), rows)
+
+
+def test_pagestore_rejects_bad_args():
+    with pytest.raises(ValueError):
+        PageStore(np.zeros(8), page_rows=0)
+    with pytest.raises(ValueError):
+        PageStore(np.float32(3.0), page_rows=4)
+
+
+# ---------------------------------------------------------------------------
+# TieredStore
+
+
+def _tiered(n_rows=64, page_rows=8, d=4, **kw):
+    rows = np.arange(n_rows * d, dtype=np.float32).reshape(n_rows, d)
+    return TieredStore(PageStore(rows, page_rows), name="t", **kw), rows
+
+
+def _device_page(tiered, page):
+    pool, page_slot = tiered.view()
+    return np.asarray(pool[int(np.asarray(page_slot)[page])])
+
+
+def test_ensure_resident_hits_misses_and_view():
+    t, _rows = _tiered()
+    assert t.n_pages == 8 and t.slots == 8
+    t.ensure_resident([0, 3])
+    assert t.stats()["misses"] == 2 and t.stats()["hits"] == 0
+    assert t.resident_count == 2
+    t.ensure_resident([3, 5])
+    st = t.stats()
+    assert st["misses"] == 3 and st["hits"] == 1
+    # the device view reads back bitwise what the cold tier holds
+    for p in (0, 3, 5):
+        np.testing.assert_array_equal(_device_page(t, p), t.store.pages[p])
+    # non-resident pages map to slot -1 in the device table
+    assert int(np.asarray(t.view()[1])[1]) == -1
+    np.testing.assert_array_equal(np.sort(t.resident_pages()), [0, 3, 5])
+
+
+def test_request_larger_than_pool_is_loud():
+    t, _ = _tiered(max_slots=3)
+    with pytest.raises(BudgetExceeded, match="4 pages requested"):
+        t.ensure_resident([0, 1, 2, 3])
+    # and nothing about the store broke: a fitting request still lands
+    t.ensure_resident([0, 1, 2])
+    assert t.resident_count == 3
+
+
+def test_clock_eviction_and_protection():
+    t, _ = _tiered(max_slots=4)
+    t.ensure_resident([0, 1, 2, 3])
+    # a full-width admission of NEW pages must evict all four old ones
+    # yet never victimize its own just-claimed slots mid-admission
+    t.ensure_resident([4, 5, 6, 7])
+    st = t.stats()
+    assert st["evictions"] == 4 and st["resident"] == 4
+    np.testing.assert_array_equal(np.sort(t.resident_pages()), [4, 5, 6, 7])
+    for p in (4, 5, 6, 7):
+        np.testing.assert_array_equal(_device_page(t, p), t.store.pages[p])
+    page_slot = np.asarray(t.view()[1])
+    assert (page_slot[:4] == -1).all()          # evicted pages unmapped
+
+
+def test_explicit_evict_returns_page_ids():
+    t, _ = _tiered(max_slots=4)
+    t.ensure_resident([0, 1, 2])
+    out = t.evict(2)
+    assert len(out) == 2 and set(out) <= {0, 1, 2}
+    assert t.resident_count == 1
+    # evicting more than resident stops at empty, no error
+    assert len(t.evict(10)) == 1
+    assert t.resident_count == 0
+
+
+def test_thrash_counter_fires_on_refetch_within_window():
+    t, _ = _tiered(max_slots=2)
+    for _ in range(4):                          # ping-pong two working sets
+        t.ensure_resident([0, 1])
+        t.ensure_resident([2, 3])
+    st = t.stats()
+    assert st["thrash"] > 0
+    assert st["evictions"] >= 6
+
+
+def test_prefetch_is_async_and_counted():
+    t, _ = _tiered()
+    assert t.prefetch([1, 2]) is True
+    t._prefetch_q.join()                        # drain the worker
+    assert t.resident_count == 2
+    assert t.stats()["prefetched"] == 2
+    # prefetching resident pages is accepted and does nothing
+    assert t.prefetch([1, 2]) is True
+    assert t.stats()["prefetched"] == 2
+    np.testing.assert_array_equal(_device_page(t, 2), t.store.pages[2])
+
+
+def test_pin_identity_bitwise_and_refusals():
+    t, rows = _tiered()
+    t.ensure_resident([5])                      # partial placement first
+    t.pin_identity()
+    assert t.stats()["pinned"] is True
+    pool, page_slot = t.view()
+    np.testing.assert_array_equal(np.asarray(page_slot), np.arange(8))
+    # the flat pool IS the padded host buffer, bitwise
+    np.testing.assert_array_equal(
+        np.asarray(pool).reshape(-1, rows.shape[1]), t.store.data
+    )
+    t.pin_identity()                            # idempotent
+    with pytest.raises(RuntimeError, match="pinned"):
+        t.evict(1)
+    small, _ = _tiered(max_slots=4)
+    with pytest.raises(BudgetExceeded, match="identity pinning"):
+        small.pin_identity()
+
+
+def test_budget_sizes_slots_and_close_releases():
+    rows = np.zeros((64, 4), np.float32)
+    store = PageStore(rows, 8)                  # 8 pages × 128 B
+    budget = MemoryBudget(3 * store.page_bytes + 4 * store.n_pages)
+    t = TieredStore(store, name="b", budget=budget)
+    assert t.slots == 3                         # the admission formula
+    assert budget.reserved() == 3 * store.page_bytes + 4 * store.n_pages
+    t.close()
+    assert budget.reserved() == 0
+    t.close()                                   # idempotent
+    tiny = MemoryBudget(10)
+    with pytest.raises(BudgetExceeded, match="single"):
+        TieredStore(store, name="tiny", budget=tiny)
+
+
+def test_stats_and_nbytes_account_both_tiers():
+    t, _ = _tiered(max_slots=4)
+    st = t.stats()
+    assert st["slots"] == 4 and st["n_pages"] == 8
+    assert st["host_only"] == 8 and st["resident"] == 0
+    assert st["hot_bytes"] == t.nbytes
+    assert st["cold_bytes"] == t.store.nbytes
+    pool, page_slot = t.view()
+    assert t.nbytes == pool.nbytes + page_slot.nbytes
+
+
+def test_page_thrash_is_a_registered_event_kind():
+    from raft_tpu.obs import events
+
+    assert "page_thrash" in events.KINDS
+    assert "page_thrash" in events.TRIGGER_KINDS

@@ -1,0 +1,306 @@
+"""Paged index storage wired through the backends and the serving layer
+(ISSUE 16's acceptance surface):
+
+* paged search is **result-identical** to the monolithic control on all
+  four backends — including under MutableIndex churn (tombstones + side
+  buffers), because the paged gather reproduces the monolithic gather
+  bitwise for resident pages;
+* an IVF index larger than the hot pool still serves (demand paging with
+  clock eviction), while the dense-scan backends (brute_force / cagra)
+  fail loudly with :class:`BudgetExceeded` instead of thrashing;
+* pagination survives the MutableIndex save/load roundtrip (page size,
+  pinning, and the resident set are restored);
+* the compactor's projected-bytes gate consults the shared page-budget
+  ledger (abort reason ``"budget"``), ``healthz()`` folds the ledger in,
+  and ``RAFT_TPU_PAGED=1`` auto-paginates served indexes with the page
+  gauges replacing the (retired) monolithic live-bytes series.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import serve
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.serve.compactor import CompactionPolicy, Compactor
+from raft_tpu.store import (
+    BudgetExceeded,
+    MemoryBudget,
+    default_budget,
+    paginate_index,
+    set_default_budget,
+)
+
+N, D, K = 400, 24, 10
+PR = 8  # page_rows: small so every index spans many pages
+
+KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((N, D)).astype(np.float32)
+    q = rng.standard_normal((16, D)).astype(np.float32)
+    return x, q
+
+
+def _build(kind: str, x: np.ndarray, n_probes: int = 16) -> serve.MutableIndex:
+    if kind == "brute_force":
+        return serve.MutableIndex(brute_force.build(x))
+    if kind == "ivf_flat":
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x)
+        return serve.MutableIndex(
+            idx, search_params=ivf_flat.SearchParams(n_probes=n_probes)
+        )
+    if kind == "ivf_pq":
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=24, pq_bits=8), x
+        )
+        return serve.MutableIndex(
+            idx, search_params=ivf_pq.SearchParams(n_probes=n_probes)
+        )
+    idx = cagra.build(cagra.IndexParams(graph_degree=32), x)
+    return serve.MutableIndex(
+        idx, search_params=cagra.SearchParams(itopk_size=128)
+    )
+
+
+def _ivf_page_budget(index, frac: float) -> MemoryBudget:
+    """A budget granting ``frac`` of the index's page set — the
+    TieredStore admission formula run backwards, so slots are exact."""
+    ld = np.asarray(index.list_data)
+    ppl = -(-ld.shape[1] // PR)
+    n_pages = ld.shape[0] * ppl
+    page_bytes = PR * int(np.prod(ld.shape[2:], dtype=np.int64)) * ld.itemsize
+    slots = max(1, int(frac * n_pages))
+    return MemoryBudget(slots * page_bytes + 4 * n_pages)
+
+
+# ---------------------------------------------------------------------------
+# result identity, all four backends, under churn
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_paged_search_identical_under_churn(corpus, kind):
+    """Same MutableIndex, before vs after pagination: churn first
+    (tombstones in the main index + rows in the side buffer), search,
+    paginate in place, search again — ids must be identical and
+    distances bitwise, because pagination changed the storage layout
+    and nothing else."""
+    x, q = corpus
+    mi = _build(kind, x)
+    mi.delete(np.arange(0, 40))
+    rng = np.random.default_rng(5)
+    mi.upsert(rng.standard_normal((12, D)).astype(np.float32))
+
+    d0, i0 = mi.search(q, K)
+    tiered = paginate_index(mi.index, page_rows=PR, budget=None,
+                            name=f"parity:{kind}")
+    assert tiered is mi.index.paged
+    assert tiered.n_pages > 1
+    d1, i1 = mi.search(q, K)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    # idempotent: a second paginate returns the same pager, untouched
+    assert paginate_index(mi.index) is tiered
+
+
+@pytest.mark.parametrize("kind", ("ivf_flat", "ivf_pq"))
+def test_ivf_serves_payload_larger_than_hot_pool(corpus, kind):
+    """The over-HBM-budget acceptance arm: slots < pages, per-query
+    dispatch keeps each probed-page union inside the pool, and the
+    results still match the monolithic control exactly while the clock
+    pager demonstrably evicts."""
+    x, q = corpus
+    mono = _build(kind, x, n_probes=4)
+    paged = _build(kind, x, n_probes=4)
+    budget = _ivf_page_budget(paged.index, 0.6)
+    tiered = paginate_index(paged.index, page_rows=PR, budget=budget,
+                            name=f"over:{kind}")
+    assert tiered.slots < tiered.n_pages, tiered.stats()
+    for row in q:
+        d0, i0 = mono.search(row[None], K)
+        d1, i1 = paged.search(row[None], K)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    st = tiered.stats()
+    assert st["misses"] > 0
+    assert st["evictions"] > 0, (
+        "over-budget serving never evicted — the pool silently fit "
+        f"everything: {st}"
+    )
+
+
+@pytest.mark.parametrize("kind", ("brute_force", "cagra"))
+def test_dense_backends_fail_loud_when_over_budget(corpus, kind):
+    """brute_force/cagra scan arbitrary rows per dispatch, so a pool
+    smaller than the payload must raise BudgetExceeded at first search
+    (identity pinning), never thrash."""
+    x, q = corpus
+    mi = _build(kind, x)
+    n_pages = -(-N // PR)
+    page_bytes = PR * D * 4
+    budget = MemoryBudget(3 * page_bytes + 4 * n_pages)  # 3 slots
+    paginate_index(mi.index, page_rows=PR, budget=budget,
+                   name=f"loud:{kind}")
+    with pytest.raises(BudgetExceeded, match="identity pinning"):
+        mi.search(q, K)
+
+
+# ---------------------------------------------------------------------------
+# serialization
+
+
+def test_save_load_restores_pinned_pagination(corpus, tmp_path):
+    x, q = corpus
+    mi = _build("brute_force", x)
+    paginate_index(mi.index, page_rows=PR, budget=None, name="rt:bf")
+    mi.delete(np.arange(10))
+    d0, i0 = mi.search(q, K)        # pins identity
+    assert mi.index.paged.stats()["pinned"] is True
+    mi.save(str(tmp_path / "bf"))
+
+    lo = serve.MutableIndex.load(str(tmp_path / "bf"))
+    t2 = getattr(lo.index, "paged", None)
+    assert t2 is not None and t2.store.page_rows == PR
+    assert t2.stats()["pinned"] is True
+    d1, i1 = lo.search(q, K)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_save_load_restores_partial_residency(corpus, tmp_path):
+    x, q = corpus
+    mi = _build("ivf_flat", x, n_probes=4)
+    budget = _ivf_page_budget(mi.index, 0.6)
+    t = paginate_index(mi.index, page_rows=PR, budget=budget, name="rt:ivf")
+    d0, i0 = mi.search(q[:2], K)    # fault in a partial working set
+    resident = np.sort(t.resident_pages())
+    assert 0 < resident.size < t.n_pages
+    mi.save(str(tmp_path / "ivf"))
+
+    lo = serve.MutableIndex.load(
+        str(tmp_path / "ivf"),
+        search_params=ivf_flat.SearchParams(n_probes=4),
+    )
+    t2 = getattr(lo.index, "paged", None)
+    assert t2 is not None and t2.store.page_rows == PR
+    np.testing.assert_array_equal(np.sort(t2.resident_pages()), resident)
+    assert lo.generation == mi.generation
+    d1, i1 = lo.search(q[:2], K)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+# ---------------------------------------------------------------------------
+# growth contract
+
+
+@pytest.mark.parametrize("kind", ("ivf_flat", "ivf_pq"))
+def test_extend_on_paged_index_is_refused(corpus, kind):
+    """Growth on a paged index goes through MutableIndex side buffers
+    (re-paginated at compaction); raw extend() must refuse instead of
+    silently desynchronizing the page store."""
+    x, _q = corpus
+    mi = _build(kind, x)
+    paginate_index(mi.index, page_rows=PR, budget=None, name=f"ext:{kind}")
+    mod = ivf_flat if kind == "ivf_flat" else ivf_pq
+    with pytest.raises(ValueError, match="paged"):
+        mod.extend(mi.index, x[:4])
+    # ...while the supported growth path (side buffer) still works
+    new_ids = mi.upsert(x[:4] * 0.5)
+    assert new_ids.size == 4
+    _d, i = mi.search(x[:1] * 0.5, K)
+    assert np.asarray(i).size
+
+
+# ---------------------------------------------------------------------------
+# serving integration: compactor gate, healthz, env gate + gauges
+
+
+def test_compactor_budget_abort_shares_page_ledger(corpus):
+    x, _q = corpus
+    svc = serve.SearchService(k=K, max_batch=4, max_delay_ms=0.5,
+                              compaction=False)
+    prev = set_default_budget(MemoryBudget(10_000))
+    try:
+        mi = _build("ivf_flat", x)
+        paginate_index(mi.index, page_rows=PR, budget=None, name="gate")
+        svc.add_index("gate", mi, warmup=False)
+        mi.delete(np.arange(50))
+        comp = Compactor(
+            svc,
+            CompactionPolicy(chunk_rows=128, gate_queries=16,
+                             max_side_rows=16),
+            start=False,
+        )
+        res = comp.trigger_now("gate")
+        assert res["status"] == "aborted" and res["reason"] == "budget", res
+        assert "RAFT_TPU_PAGE_HBM_BUDGET_MB" in res["detail"]
+        comp.stop()
+    finally:
+        set_default_budget(prev)
+        svc.stop()
+
+
+def test_healthz_folds_page_budget_ledger(corpus):
+    x, _q = corpus
+    prev = set_default_budget(MemoryBudget(1 << 20))
+    svc = serve.SearchService(k=K, max_batch=4, max_delay_ms=0.5,
+                              compaction=False)
+    try:
+        svc.add_index("h", _build("brute_force", x), warmup=False)
+        report = svc.healthz()
+        assert report["budget"]["status"] == "OK", report["budget"]
+        assert report["budget"]["snapshot"]["limit_bytes"] == 1 << 20
+        # exhaust the ledger: the budget check degrades the report
+        default_budget().reserve("hog", int(0.99 * (1 << 20)))
+        report = svc.healthz()
+        assert report["budget"]["status"] == "DEGRADED", report["budget"]
+    finally:
+        svc.stop()
+        set_default_budget(prev)
+
+
+def test_env_gate_paginates_and_publishes_page_gauges(corpus, monkeypatch):
+    x, q = corpus
+    monkeypatch.setenv("RAFT_TPU_PAGED", "1")
+    svc = serve.SearchService(k=K, max_batch=4, max_delay_ms=0.5,
+                              compaction=False)
+    try:
+        mi = _build("ivf_flat", x)
+        svc.add_index("pg", mi, warmup=False)
+        tiered = getattr(mi.index, "paged", None)
+        assert tiered is not None, "RAFT_TPU_PAGED=1 did not paginate"
+        svc.submit("pg", q[0]).result(timeout=120)
+
+        from raft_tpu.obs import cost as obs_cost
+
+        pages = obs_cost.refresh_page_gauges(svc.registry)
+        (key,) = [k for k in pages if k.startswith("pg:")]
+        assert pages[key]["resident"] > 0
+        assert pages[key]["pool_bytes"] == tiered.nbytes
+        # the monolithic live-bytes series is RETIRED for paged indexes:
+        # its device payload lives in the page gauges now, and a stale
+        # raft_tpu_index_live_bytes row would double-count it
+        live = obs_cost.refresh_live_buffer_gauges(svc.registry)
+        assert not any(k.startswith("pg:") for k in live), live
+        prom = svc.prometheus()
+        assert "raft_tpu_page_resident" in prom
+        assert "raft_tpu_page_pool_bytes" in prom
+    finally:
+        svc.stop()
+
+
+def test_unpaged_control_arm_is_the_default(corpus):
+    """With the env gate off (the default), add_index leaves the index
+    monolithic — the control arm of the rollout."""
+    x, _q = corpus
+    svc = serve.SearchService(k=K, max_batch=4, max_delay_ms=0.5,
+                              compaction=False)
+    try:
+        mi = _build("brute_force", x)
+        svc.add_index("ctl", mi, warmup=False)
+        assert getattr(mi.index, "paged", None) is None
+    finally:
+        svc.stop()

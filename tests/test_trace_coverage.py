@@ -49,13 +49,14 @@ def test_entry_point_discovery_is_not_vacuous(project):
 
 
 def test_serve_surface_discovery_is_not_vacuous(result):
-    # all twenty online entry points (service/mutation/ragged/compactor
-    # plus the SLO evaluator, incident ingest, the overload trio, the
-    # perf-ledger pair, the sharded rebuild, and the two module-level
-    # build entry points) checked, against exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 20, result.stats
+    # all twenty-three online entry points (service/mutation/ragged/
+    # compactor plus the SLO evaluator, incident ingest, the overload
+    # trio, the perf-ledger pair, the sharded rebuild, the two
+    # module-level build entry points, and the page-store pager trio)
+    # checked, against exactly one MicroBatcher
+    assert result.stats["traced_serve_entries_checked"] == 23, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
-    assert result.stats["traced_labels"] >= 20, result.stats
+    assert result.stats["traced_labels"] >= 23, result.stats
 
 
 def test_trace_coverage_is_clean(result):
