@@ -210,6 +210,12 @@ def main() -> None:
     if "slo" in sys.argv[1:]:
         run_slo_leg()
         return
+    if "autotune" in sys.argv[1:]:
+        run_autotune_leg()
+        return
+    if "deep" in sys.argv[1:]:
+        run_deep_leg()
+        return
     if "kernels" in sys.argv[1:]:
         run_kernels_leg()
         return
@@ -1895,6 +1901,447 @@ def run_slo_leg() -> None:
             "recompiles": on["recompiles"] + off["recompiles"],
             "requests": n_requests,
             "n": n,
+        }
+    )
+
+
+def run_autotune_leg() -> None:
+    """``python bench.py autotune`` — closed-loop autotuner A/B (CPU).
+
+    Two arms run the identical paced-device serve workload through
+    three phases — healthy, injected p99 breach (the paced device slows
+    ``slow_mult``×, the "TPU neighbor got noisy" incident), healthy
+    again:
+
+    - ``off``: no controller — the breach persists for the whole slow
+      phase (per-tick p99 stays over the latency target);
+    - ``on``: an :class:`raft_tpu.obs.autotune.Autotuner` watches the
+      index through its :class:`raft_tpu.serve.effort.EffortArbiter`;
+      the ``slo_burn`` edge drives an effort descent (fewer probes →
+      proportionally less device time) that restores p99 within the
+      controller window, the measured recall EWMA holds ≥ the floor the
+      whole run, and effort climbs back to full once the slowdown
+      lifts.
+
+    The per-level recall feeding the controller is *measured* up front
+    (exact groundtruth vs the derived params at every warmed ladder
+    level), not assumed.  Both arms assert zero post-warmup recompiles
+    (every level was warmed); the on arm additionally asserts a
+    correlated incident timeline carrying the ``slo_burn`` →
+    ``autotune_step`` chain.  Frozen record:
+    ``benchmarks/BENCH_autotune_r18.json``.
+    """
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import obs
+    from raft_tpu.neighbors import effort as neighbors_effort
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import autotune as obs_autotune
+    from raft_tpu.obs import incidents as obs_incidents
+    from raft_tpu.obs import slo, slowlog
+    from raft_tpu.serve.batcher import MicroBatcher
+    from raft_tpu.serve.effort import EffortArbiter
+    from raft_tpu.serve.metrics import ServingMetrics
+    from raft_tpu.stats import recall_at_k
+
+    n, d, k = 8192, 32, 10
+    n_lists, base_probes = 64, 32
+    reqs_per_tick = 32
+    # the paced deadline is a FLOOR under the real jax dispatch (~25-35 ms
+    # per full-effort batch on CPU), so the synthetic device pace must
+    # dominate it for effort moves to be visible in latency
+    device_ms = 40.0      # healthy device-plane ms per batch at full effort
+    # the latency SLO counts whole histogram buckets (the evaluator reads
+    # bucket totals, never reservoirs), so the target sits just above the
+    # 204.8 ms bucket edge: healthy (~45 ms) and one-descent (~170 ms)
+    # traffic is good, the injected breach (~320 ms) is not
+    target_s = 0.205
+    slow_mult = 8.0       # injected slowdown: 320 ms at level 0 breaches,
+    #                       160 ms at level 1 clears — one descent suffices
+    floor = 0.9
+    max_level = 3
+    healthy_ticks, slow_ticks, recover_ticks = 8, 12, 12
+
+    obs.install()
+    slowlog.configure(None)  # paced batches outlast the slow threshold
+    rng = np.random.default_rng(0)
+    # clustered corpus (mixture of gaussians): IVF recall stays high at
+    # every ladder level, so the floor *gates* descent instead of
+    # blocking it — uniform data would put the deep levels under 0.9
+    centers = rng.random((n_lists, d), dtype=np.float32) * 10
+    lab = rng.integers(0, n_lists, n)
+    dataset = (centers[lab]
+               + rng.normal(0, 1.0, (n, d))).astype(np.float32)
+    qlab = rng.integers(0, n_lists, reqs_per_tick * 4)
+    queries = (centers[qlab]
+               + rng.normal(0, 1.0, (len(qlab), d))).astype(np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists), dataset)
+    base_params = ivf_flat.SearchParams(n_probes=base_probes)
+
+    # measured recall per warmed ladder level (exact numpy groundtruth):
+    # the controller's quality input is real, precomputed once
+    d2 = (
+        (queries**2).sum(1)[:, None]
+        + (dataset**2).sum(1)[None, :]
+        - 2.0 * queries @ dataset.T
+    )
+    gt = np.argsort(d2, axis=1)[:, :k].astype(np.int32)
+    spec = neighbors_effort.spec_for_params(base_params)
+    recall_by_level = {}
+    for level in range(max_level + 1):
+        p = spec.degraded(level).apply(base_params)
+        _, ids = ivf_flat.search(p, index, jnp.asarray(queries), k)
+        recall_by_level[level] = float(recall_at_k(np.asarray(ids), gt))
+
+    class _ServedIndex:
+        """MutableIndex-shaped view: what the arbiter reads per dispatch."""
+
+        def __init__(self, params):
+            self.search_params = params
+            self.kind = "ivf_flat"
+
+    served = _ServedIndex(base_params)
+
+    class _LevelRecallTap:
+        """Auditor stand-in: reports the measured recall of the level
+        the arbiter is actually serving at."""
+
+        def __init__(self, arb):
+            self._arb = arb
+
+        def recall_ewma(self, name):
+            return recall_by_level[self._arb.effective_level()]
+
+    class _Paced:
+        __slots__ = ("arr", "deadline")
+
+        def __init__(self, arr, deadline: float):
+            self.arr = arr
+            self.deadline = deadline
+
+        def block_until_ready(self):
+            jax.block_until_ready(self.arr)
+            rest = self.deadline - time.perf_counter()
+            if rest > 0:
+                time.sleep(rest)  # releases the GIL, like a TPU RPC
+            return self
+
+        def __array__(self, dtype=None):
+            a = np.asarray(self.arr)
+            return a if dtype is None else a.astype(dtype)
+
+    slow = {"mult": 1.0}
+
+    def make_paced_search(arb):
+        lock = threading.Lock()
+        state = {"free": 0.0}
+
+        def search_fn(batch):
+            params = arb.apply(served) if arb is not None else None
+            p = params if params is not None else base_params
+            dist, ids = ivf_flat.search(p, index, batch, k)
+            # device time tracks effort: fewer probes, less device work
+            busy = (device_ms * 1e-3 * slow["mult"]
+                    * p.n_probes / base_probes)
+            with lock:
+                start = max(time.perf_counter(), state["free"])
+                state["free"] = deadline = start + busy
+            return _Paced(dist, deadline), _Paced(ids, deadline)
+
+        return search_fn
+
+    def run_arm(with_tuner: bool, tag: str) -> dict:
+        arb = None
+        if with_tuner:
+            arb = EffortArbiter(None, max_level=max_level, name=tag)
+        batcher = MicroBatcher(
+            make_paced_search(arb), d, max_batch=reqs_per_tick,
+            # 2 ms cut delay: each tick's 32 submits land in ONE full
+            # batch, so per-request latency is the device pace, not a
+            # second-batch queue wait straddling a bucket edge
+            max_delay_ms=2.0, metrics=ServingMetrics(name=tag),
+            pipeline_depth=1, effort=arb,
+        )
+        batcher.warmup()
+        # settle ticks: a fresh batcher's first dispatches pay one-off
+        # thread/dispatch cold-start (tens of ms).  They run BEFORE the
+        # SLO spec exists — add_spec primes the counter baseline, so
+        # cold-start latency never counts against the budget
+        for _ in range(2):
+            for f in [batcher.submit(queries[i % len(queries)])
+                      for i in range(reqs_per_tick)]:
+                f.result(timeout=120)
+        engine = slo.SloEngine(
+            [slo.SloSpec(f"{tag}-latency", tag, "latency",
+                         objective=0.99, target=target_s)],
+            eval_s=1.0, scale=1.0 / 600.0,
+        )
+        tuner = None
+        tap = None
+        if with_tuner:
+            tap = _LevelRecallTap(arb)
+            tuner = obs_autotune.Autotuner(
+                eval_s=3600.0, recall_floor=floor,
+                degrade_ticks=2, restore_ticks=6,
+            )
+            tuner.watch_index(tag, arb, auditor=tap, slo=engine)
+
+        t_syn = 0.0
+        ticks = []
+        first_burn = None
+        t_wall0 = time.perf_counter()
+        for phase, n_ticks, mult in (
+            ("healthy", healthy_ticks, 1.0),
+            ("slow", slow_ticks, slow_mult),
+            ("recover", recover_ticks, 1.0),
+        ):
+            slow["mult"] = mult
+            for _ in range(n_ticks):
+                t_syn += 1.0
+                t0 = time.perf_counter()
+                futs = [
+                    batcher.submit(queries[i % len(queries)])
+                    for i in range(reqs_per_tick)
+                ]
+                lat = []
+                for f in futs:
+                    f.result(timeout=120)
+                    lat.append(time.perf_counter() - t0)
+                engine.evaluate_once(now=t_syn)
+                if tuner is not None:
+                    tuner.evaluate_once(now=t_syn)
+                burning = f"{tag}-latency" in engine.paging()
+                if burning and first_burn is None:
+                    first_burn = len(ticks)
+                lvl = arb.autotune_level if arb is not None else 0
+                ticks.append({
+                    "phase": phase,
+                    "min_ms": round(min(lat) * 1e3, 2),
+                    "p99_ms": round(
+                        sorted(lat)[max(0, int(0.99 * len(lat)) - 1)]
+                        * 1e3, 2),
+                    "level": lvl,
+                    "burning": burning,
+                    "recall": round(
+                        recall_by_level[
+                            arb.effective_level() if arb is not None
+                            else 0], 4),
+                })
+        wall = time.perf_counter() - t_wall0
+        st = batcher.metrics.snapshot()
+        engine.stop()
+        if tuner is not None:
+            tuner.stop()
+        batcher.stop()
+        n_requests = reqs_per_tick * len(ticks)
+        return {
+            "qps": round(n_requests / wall, 1),
+            "recompiles": st["recompiles"],
+            "warmup_compiles": st["warmup_compiles"],
+            "first_burn_tick": first_burn,
+            "max_level": max(t["level"] for t in ticks),
+            "final_level": ticks[-1]["level"],
+            "min_recall": min(t["recall"] for t in ticks),
+            "ticks": ticks,
+        }
+
+    run_arm(False, "bench_tune_warm")  # discarded: jit/thread warmth
+    off = run_arm(False, "bench_tune_off")
+    on = run_arm(True, "bench_tune_on")
+    if os.environ.get("RAFT_TPU_BENCH_DEBUG"):
+        for arm_tag, arm in (("off", off), ("on", on)):
+            for i, t in enumerate(arm["ticks"]):
+                print(f"  {arm_tag}[{i:2d}] {t['phase']:8s} "
+                      f"min={t['min_ms']:8.2f} p99={t['p99_ms']:8.2f} "
+                      f"level={t['level']} burn={t['burning']}",
+                      file=sys.stderr)
+            print(f"  {arm_tag} first_burn={arm['first_burn_tick']}",
+                  file=sys.stderr)
+
+    target_ms = target_s * 1e3
+    slow_off = [t for t in off["ticks"] if t["phase"] == "slow"]
+    slow_on = [t for t in on["ticks"] if t["phase"] == "slow"]
+    rec_on = [t for t in on["ticks"] if t["phase"] == "recover"]
+
+    # -- the A/B story, asserted before emitting ------------------------
+    # off arm: the breach persists — most slow-phase ticks stay over
+    # the target (all of them, absent scheduler noise)
+    off_over = sum(1 for t in slow_off if t["p99_ms"] > target_ms)
+    assert off_over >= len(slow_off) - 1, (
+        f"off arm never breached: {off_over}/{len(slow_off)} slow ticks "
+        "over target — the injected slowdown is broken"
+    )
+    # on arm: the controller shed effort...
+    assert on["max_level"] > 0, "autotuner never stepped effort down"
+    assert on["first_burn_tick"] is not None, "latency SLO never burned"
+    # ...which restored p99 within the controller window (degrade_ticks
+    # descents after the first burn, plus one tick for the pipeline to
+    # drain the pre-descent pace)
+    window = 4
+    restored = None
+    for i, t in enumerate(on["ticks"]):
+        if (on["first_burn_tick"] is not None
+                and i > on["first_burn_tick"] and t["phase"] == "slow"
+                and t["p99_ms"] <= target_ms):
+            restored = i - on["first_burn_tick"]
+            break
+    assert restored is not None and restored <= window, (
+        f"on arm p99 not restored within {window} ticks of the burn: "
+        f"{[t['p99_ms'] for t in slow_on]}"
+    )
+    # ...while measured recall held the floor the whole run...
+    assert on["min_recall"] >= floor, (
+        f"recall EWMA fell below the floor: {on['min_recall']} < {floor}"
+    )
+    # ...and effort climbed back to full once the slowdown lifted
+    assert on["final_level"] == 0, (
+        f"effort never climbed back: final level {on['final_level']}, "
+        f"recover ticks {[(t['level'], t['p99_ms']) for t in rec_on]}"
+    )
+    # zero-recompile contract across the whole A/B: every ladder level
+    # was warmed, so no effort move may compile on the hot path
+    assert off["recompiles"] == 0 and on["recompiles"] == 0, (
+        f"hot-path recompiles: off={off['recompiles']} "
+        f"on={on['recompiles']}"
+    )
+    # the correlated incident: ONE incident's story contains both the
+    # on-arm slo_burn and the autotune_step it provoked.  (The off arm
+    # burns first and opens the incident; the on arm's events land in
+    # the same still-fresh timeline — correlation by design, so the
+    # chain is searched across trigger + timeline, not just the trigger.)
+    chain = None
+    mgr = obs_incidents.default_manager()
+    for inc in mgr.open_incidents() + mgr.closed_incidents():
+        doc = inc.to_dict()
+        story = [doc.get("trigger", {})] + list(doc.get("timeline", []))
+        burns = [e for e in story
+                 if e.get("kind") == "slo_burn" and not e.get("recovered")
+                 and e.get("index") == "bench_tune_on"]
+        steps = [e for e in story
+                 if e.get("kind") == "autotune_step"
+                 and e.get("index") == "bench_tune_on"]
+        if burns and steps:
+            chain = {
+                "incident_id": doc.get("id"),
+                "trigger": "slo_burn",
+                "autotune_steps": len(steps),
+                "first_step_reason": steps[0].get("step_reason"),
+            }
+            break
+    assert chain is not None, (
+        "no incident correlates the slo_burn with an autotune_step"
+    )
+
+    # headline p99: the plateau right after restoration (the controller
+    # re-probes full effort later in the slow phase, which is part of the
+    # story but not a stable number to regress against)
+    post = on["ticks"][on["first_burn_tick"] + restored:
+                       on["first_burn_tick"] + restored + 3]
+    recovery_p99 = max(t["p99_ms"] for t in post) if post else None
+    _emit(
+        {
+            "metric": f"serve_autotune_closed_loop_ivf_flat_"
+                      f"n{n // 1000}k_k{k}",
+            "value": on["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "device_ms": device_ms,
+            "slow_mult": slow_mult,
+            "target_ms": target_ms,
+            "recall_floor": floor,
+            "recall_by_level": {
+                str(lv): round(r, 4) for lv, r in recall_by_level.items()
+            },
+            "restored_within_ticks": restored,
+            "p99_ms": recovery_p99,
+            "recall": on["min_recall"],
+            "recompiles": off["recompiles"] + on["recompiles"],
+            "incident_chain": chain,
+            "autotune_on": {kk: vv for kk, vv in on.items()
+                            if kk != "ticks"},
+            "autotune_off": {kk: vv for kk, vv in off.items()
+                             if kk != "ticks"},
+            "on_levels": [t["level"] for t in on["ticks"]],
+            "on_p99_ms": [t["p99_ms"] for t in on["ticks"]],
+            "off_p99_ms": [t["p99_ms"] for t in off["ticks"]],
+            "phases": {"healthy": healthy_ticks, "slow": slow_ticks,
+                       "recover": recover_ticks},
+        }
+    )
+
+
+def run_deep_leg() -> None:
+    """``python bench.py deep`` — dataset-scale DEEP-geometry frontier.
+
+    Runs the :mod:`raft_tpu.bench.frontier` sweep on the DEEP synthetic
+    geometry (96-dim inner product) at ``RAFT_TPU_BENCH_DEEP_N`` rows
+    (default 100K; the harness is 100M-capable — the sharded path
+    (``RAFT_TPU_BENCH_DEEP_SHARDS``) builds via ``build_sharded`` so
+    the corpus never has to fit one device), then emits the best
+    serve-backend operating point at recall ≥ 0.9 plus the serialized
+    :class:`~raft_tpu.obs.autotune.FrontierModel` the serving autotuner
+    loads through ``RAFT_TPU_FRONTIER_PATH``.
+    """
+    import jax
+
+    if os.environ.get("RAFT_TPU_BENCH_DEEP_PLATFORM", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from raft_tpu.bench import frontier as frontier_mod
+
+    n = int(os.environ.get("RAFT_TPU_BENCH_DEEP_N", "100000"))
+    shards = int(os.environ.get("RAFT_TPU_BENCH_DEEP_SHARDS", "0"))
+    n_queries = int(os.environ.get("RAFT_TPU_BENCH_DEEP_QUERIES", "1000"))
+    k = 10
+    ds = frontier_mod.make_dataset(
+        "deep-image-96-inner", n, n_queries=n_queries, k=k,
+    )
+    n_rows, dim = int(ds.base.shape[0]), int(ds.base.shape[1])
+    if shards:
+        results = frontier_mod.sweep_sharded(
+            ds, kinds=sorted(frontier_mod.SERVE_BACKENDS), k=k,
+            n_devices=shards,
+        )
+    else:
+        grids = frontier_mod.default_grids(
+            n_rows, dim, ds.metric, comparators=False)
+        results = frontier_mod.sweep(
+            ds, grids, k=k,
+            checkpoint_path=f"bench_deep_{n_rows}.json.partial",
+        )
+    model = frontier_mod.frontier_model(
+        results, n_queries=n_queries,
+        meta={"dataset": ds.name, "n": n_rows, "dim": dim, "k": k,
+              "n_queries": n_queries, "metric": ds.metric,
+              "sharded": shards,
+              "platform": jax.devices()[0].platform},
+    )
+    out = os.environ.get("RAFT_TPU_BENCH_DEEP_OUT",
+                         f"frontier_model_deep_{n_rows}.json")
+    model.save(out)
+    good = [r for r in results if r.recall >= 0.9] or results
+    head = max(good, key=lambda r: r.qps)
+    _emit(
+        {
+            "metric": f"deep_frontier_n{n_rows}_k{k}",
+            "value": round(head.qps, 1),
+            "unit": "queries/s",
+            "platform": jax.devices()[0].platform,
+            "recall": round(head.recall, 4),
+            "algo": head.algo,
+            "search_param": head.search_param,
+            "sharded": shards,
+            "frontier_path": out,
+            "pareto_points": sum(
+                len(p) for p in model.points.values()),
+            "backends": model.backends(),
         }
     )
 

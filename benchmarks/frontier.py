@@ -1,233 +1,23 @@
 #!/usr/bin/env python
-"""Multi-algorithm recall/QPS pareto frontier artifact.
+"""Thin shim: the frontier sweep lives in :mod:`raft_tpu.bench.frontier`.
 
-The raft-ann-bench comparison shape (ref: docs/source/raft_ann_benchmarks.md
-plots; competitor wrappers cpp/bench/ann/src/{faiss,hnswlib}/): every
-algorithm in the harness — raft_tpu indexes plus the numpy-exact and
-hnswlib-format comparators — swept over its tuning grid on one dataset,
-pareto-filtered, written as JSON + PNG.
+Preferred entry point:
 
-    python benchmarks/frontier.py [--n 100000] [--platform cpu] [--scale-tag x]
+    python -m raft_tpu.bench frontier [--n 100000] [--platform cpu] ...
 
-Writes benchmarks/frontier_<platform>.json and .png.
+This file stays so existing invocations (``python benchmarks/frontier.py``)
+keep working; it forwards argv unchanged.
 """
 
-import argparse
-import json
 import os
 import sys
-import time
 
-import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=100_000)
-    ap.add_argument("--dataset", default="deep-image-96-inner",
-                    help="synthetic stand-in geometry (see bench.datasets)")
-    ap.add_argument("--queries", type=int, default=1000)
-    ap.add_argument("--k", type=int, default=10)
-    ap.add_argument("--platform", default="", help="e.g. cpu to force a backend")
-    ap.add_argument("--algos", default="",
-                    help="comma-filter, e.g. numpy_exact,raft_tpu_ivf_pq")
-    ap.add_argument("--out", default="")
-    args = ap.parse_args()
-
-    import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-    platform = jax.devices()[0].platform
-
-    from raft_tpu.bench import datasets, plot, runner
-    from raft_tpu.bench.datasets import _SYNTH_SHAPES
-
-    full_n = _SYNTH_SHAPES[args.dataset][0]
-    ds = datasets.synthetic(
-        args.dataset, scale=args.n / full_n, n_queries=args.queries,
-    )
-    ds = datasets.generate_groundtruth(ds, k=args.k)
-    n = ds.base.shape[0]
-    dim = ds.base.shape[1]
-    args.dim = dim
-
-    grids = [
-        ("numpy_exact", {}, [{}]),
-        ("raft_tpu_brute_force", {}, [{}]),
-        (
-            "raft_tpu_ivf_flat",
-            {"n_lists": max(64, n // 500)},
-            [{"n_probes": p} for p in (4, 8, 16, 32, 64)],
-        ),
-        (
-            # pq_dim = d/2 (the reference's sift-1M grid region) — the
-            # auto d/4 is too coarse past ~64 dims for recall≥0.9 at k=10
-            "raft_tpu_ivf_pq",
-            {"n_lists": max(64, n // 500), "pq_dim": dim // 2},
-            [{"n_probes": p} for p in (4, 8, 16, 32, 64)]
-            + [{"n_probes": p, "refine_ratio": r}
-               for p in (8, 16, 32) for r in (2, 4)],
-        ),
-        (
-            # deg-64 graph + entry-point-seeded w=1 walks — the winning
-            # region from the round-4 sweep (the old deg-32 w∈{2,4} grid
-            # never reached the pareto front; see ROUND4_NOTES)
-            "raft_tpu_cagra",
-            {"graph_degree": 64, "intermediate_graph_degree": 128},
-            [
-                {"itopk_size": t, "search_width": 1, "max_iterations": mi,
-                 "num_entry_centers": s}
-                for t in (16, 32)
-                for mi in (3, 4, 6, 8)
-                for s in (8, 16)
-            ]
-            + [{"itopk_size": 64, "search_width": 1},
-               {"itopk_size": 64, "search_width": 4}],
-        ),
-        (
-            # half-the-gather-bytes CAGRA: bf16 traversal dataset (the
-            # beam search is gather-bandwidth-bound; see runner.CagraANN)
-            "raft_tpu_cagra_bf16",
-            {"graph_degree": 64, "intermediate_graph_degree": 128},
-            [
-                {"itopk_size": t, "search_width": 1, "max_iterations": mi,
-                 "num_entry_centers": 16}
-                for t in (16, 32) for mi in (4, 6, 8)
-            ],
-        ),
-        (
-            # memory-lean CAGRA: VPQ-compressed dataset, decode-on-gather
-            "raft_tpu_cagra_vpq",
-            {"graph_degree": 64, "intermediate_graph_degree": 128},
-            [
-                {"itopk_size": t, "search_width": 1, "max_iterations": mi,
-                 "num_entry_centers": 16}
-                for t in (16, 32) for mi in (4, 8)
-            ],
-        ),
-        ("hnswlib_format", {"graph_degree": 32}, [{"ef": e} for e in (32, 64, 128)]),
-        # same exported file, searched by the native C++ HNSW engine
-        # (cpp/src/hnsw.cc) — host-CPU graph search, threaded over queries.
-        # n_seeds=1 is stock hnswlib semantics; the seeded rungs cover
-        # directed-graph / MIP workloads where one entry routes poorly
-        ("hnsw_native", {"graph_degree": 32},
-         [{"ef": 64, "n_seeds": 1}, {"ef": 128, "n_seeds": 1},
-          {"ef": 128, "n_seeds": 128}, {"ef": 256, "n_seeds": 256}]),
-    ]
-    if ds.metric != "inner_product":
-        # external-library comparator: sklearn spatial trees (L2/cosine
-        # only — it refuses unnormalized MIP)
-        grids.insert(1, ("sklearn", {"algorithm": "ball_tree"}, [{}]))
-
-    if args.algos:
-        keep = set(args.algos.split(","))
-        grids = [g for g in grids if g[0] in keep]
-
-    out = args.out or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"frontier_{platform}.json"
-    )
-    # per-algo checkpoint: a tunnel death mid-sweep must not lose the
-    # completed algos' measurements (a 1M sweep is ~10 min/algo on chip) —
-    # each finished algo appends to <out>.partial and a restart resumes
-    # from it, re-running only what's missing
-    part_path = out + ".partial"
-    done_algos, results = set(), []
-    if os.path.exists(part_path):
-        try:
-            with open(part_path) as fh:
-                part = json.load(fh)
-            # dataset is part of the signature: a leftover partial from a
-            # different --dataset with matching n/k must not merge stale
-            # measurements into this artifact.  Partials written before
-            # the dataset key existed all came from the parser-default
-            # dataset — pin them to it, NOT to args.dataset (defaulting
-            # to args.dataset would resurrect exactly the cross-dataset
-            # merge this guard exists to stop).
-            if (part.get("n"), part.get("k"),
-                    part.get("dataset", "deep-image-96-inner")
-                    ) == (n, args.k, args.dataset):
-                done_algos = set(part["done_algos"])
-                results = [runner.RunResult(**d) for d in part["results"]]
-                print(f"resuming from {part_path}: {sorted(done_algos)} done")
-        except Exception as e:
-            print(f"ignoring unreadable partial ({e})")
-
-    def checkpoint():
-        with open(part_path, "w") as fh:
-            json.dump(
-                {"n": n, "k": args.k, "dataset": args.dataset,
-                 "done_algos": sorted(done_algos),
-                 "results": [r.to_dict() for r in results]}, fh,
-            )
-
-    for name, build_param, search_params in grids:
-        if name in done_algos:
-            continue
-        t0 = time.time()
-        try:
-            rs = runner.run_case(
-                ds, name, build_param, search_params, k=args.k,
-                warmup=1, iters=3,
-            )
-        except Exception as e:  # record the failure, keep the sweep going
-            print(f"{name}: FAILED ({e})")
-            if "unavailable" in str(e).lower():
-                # the backend (tunnel) died, not the algo — keep it
-                # un-done so the resume retries it, and abort instead of
-                # failing every remaining algo against a dead chip
-                checkpoint()
-                print("backend unavailable — aborting; checkpoint kept")
-                sys.exit(1)
-            done_algos.add(name)
-            checkpoint()
-            continue
-        results.extend(rs)
-        done_algos.add(name)
-        checkpoint()
-        good = [r for r in rs if r.recall >= 0.9] or rs
-        best = max(good, key=lambda r: r.qps)
-        print(
-            f"{name}: {len(rs)} points in {time.time()-t0:.0f}s; "
-            f"best{'@recall≥0.9' if good is not rs else ' (no point ≥0.9)'}: "
-            f"{best.qps:.0f} qps @ {best.recall:.3f}"
-        )
-
-    # per-algo build cost, first-class (VERDICT r4 next #4: build time
-    # gates alongside the QPS pareto — search wins don't excuse
-    # uncompetitive builds).  CAGRA variants report the real shared
-    # graph-build cost, not cache-hit time (runner build cache).
-    build_seconds = {}
-    for r in results:
-        build_seconds[r.algo] = max(
-            build_seconds.get(r.algo, 0.0), r.build_time_s)
-    for a, bs in sorted(build_seconds.items()):
-        print(f"build_s {a}: {bs:.1f}")
-    doc = {
-        "platform": platform,
-        "n": n,
-        "dim": args.dim,
-        "n_queries": int(ds.queries.shape[0]),
-        "k": args.k,
-        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
-        "build_seconds": build_seconds,
-        "frontiers": {a: pts for a, pts in plot.group_frontiers(results).items()},
-        "results": [r.to_dict() for r in results],
-    }
-    with open(out, "w") as fh:
-        json.dump(doc, fh, indent=2)
-    if os.path.exists(part_path):
-        os.remove(part_path)
-    print("wrote", out)
-    try:
-        plot.plot_results(results, out.replace(".json", ".png"),
-                          title=f"recall/QPS frontier ({platform}, n={n})")
-        print("wrote", out.replace(".json", ".png"))
-    except Exception as e:
-        print("plot skipped:", e)
-
+try:
+    from raft_tpu.bench.frontier import frontier_main
+except ModuleNotFoundError:  # direct-script run from a bare checkout
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from raft_tpu.bench.frontier import frontier_main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(frontier_main(sys.argv[1:]))

@@ -7,7 +7,13 @@ traced argument either fails to trace or — worse — silently
 specializes, recompiling per distinct value.  ``.shape``/``.ndim``/
 ``.dtype``/``len()`` are static under trace and fine to branch on;
 ``static_argnums``/``static_argnames``/``functools.partial``-bound
-parameters are Python values by construction.
+parameters are Python values by construction.  One extra contract rides
+on top: a typed search-effort knob (:data:`EFFORT_KNOB_NAMES`) may only
+be a static jit argument on the *private* warmed-variant layer
+(underscore-prefixed defs — the executables the serving warmup ladder
+precompiles per (bucket, level)).  On any public jit entry a static
+knob bypasses the ladder entirely: the autotuner actuates knob values
+per tick, and each level change would recompile.
 
 Detected jit entries: ``@jax.jit`` / ``@partial(jax.jit, ...)``
 decorated defs, and local/module functions (or lambdas / partials)
@@ -63,6 +69,16 @@ DESCRIPTOR_ENTRIES = {
     "ops.matrix.mask_row_k": ("row_k",),
 }
 
+#: typed search-effort knob names (mirrors
+#: ``neighbors.effort.EFFORT_KNOBS`` — the checker stays stdlib-only, a
+#: tier-1 test pins the two sets in sync).  Effort values are host
+#: operands that select among *warmed* executables; marking one static
+#: (``static_argnums``/``static_argnames``/partial-bound) recompiles per
+#: autotune level and defeats zero-recompile effort actuation.
+EFFORT_KNOB_NAMES = frozenset(
+    {"n_probes", "refine_ratio", "lut_dtype", "itopk_size", "search_width"}
+)
+
 
 def check(project: Project, result) -> None:
     n_entries = 0
@@ -70,6 +86,8 @@ def check(project: Project, result) -> None:
         entries = list(_jit_entries(project, mod))
         n_entries += len(entries)
         for node, static_idx, static_names, enclosing in entries:
+            _check_effort_static(project, mod, node, static_idx,
+                                 static_names, result)
             _check_entry(project, mod, node, static_idx, static_names,
                          result)
             if enclosing is not None and isinstance(
@@ -91,6 +109,35 @@ def _check_descriptor_entries(project: Project, result) -> None:
             _check_entry(project, fn.module, fn.node, set(), static,
                          result)
     result.stats["recompile_descriptor_entries"] = n_desc
+
+
+def _check_effort_static(project, mod, node, static_idx, static_names,
+                         result) -> None:
+    """Effort knobs must ride as operands on the public surface — a
+    static knob keys the jit cache, so every autotune level change
+    recompiles.  Private (underscore-prefixed) defs are exempt: they are
+    the warmed-variant layer whose per-knob executables the serving
+    warmup ladder precompiles deliberately."""
+    symbol = getattr(node, "name", "<lambda>")
+    if symbol.startswith("_"):
+        return
+    a = node.args
+    positional = [p.arg for p in (a.posonlyargs + a.args)]
+    offset = 1 if positional[:1] in (["self"], ["cls"]) else 0
+    static: Set[str] = set(static_names)
+    for i in static_idx:
+        j = i + offset
+        if 0 <= j < len(positional):
+            static.add(positional[j])
+    bad = sorted(static & EFFORT_KNOB_NAMES)
+    if not bad:
+        return
+    _emit(project, mod, node, f"{mod.name}.{symbol}", result,
+          f"effort knob(s) {', '.join(repr(b) for b in bad)} marked "
+          "static under jit on a public entry — effort values are "
+          "operands selecting among warmed executables; a static knob "
+          "here recompiles per autotune level (private warmed variants "
+          "are the one exempt layer)")
 
 
 # -- jit-entry discovery ----------------------------------------------------

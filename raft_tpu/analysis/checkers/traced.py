@@ -66,6 +66,8 @@ SERVE_ENTRY_POINTS = {
         "serve.admission.decide",
     ("serve.overload.DegradedModeManager", "step"): "serve.degrade.step",
     ("serve.overload.HedgedDispatcher", "dispatch"): "serve.hedge.dispatch",
+    ("obs.autotune.Autotuner", "step"): "autotune.step",
+    ("serve.effort.EffortArbiter", "apply"): "serve.effort.apply",
     ("obs.perf.PerfLedger", "record"): "perf.record",
     ("obs.perf.PerfLedger", "evaluate"): "perf.evaluate",
     ("store.tiered.TieredStore", "ensure_resident"): "store.pager.ensure",

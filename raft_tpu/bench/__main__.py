@@ -109,6 +109,13 @@ def main(argv=None):
         # regression gate: diff two bench records, exit nonzero on a
         # regression — ``python -m raft_tpu.bench compare --baseline X``
         return export.compare_main(argv[1:])
+    if argv and argv[0] == "frontier":
+        # QPS–recall frontier sweep → serialized FrontierModel (the
+        # autotuner's measurement leg) — lazy import keeps the default
+        # path free of the sweep machinery
+        from raft_tpu.bench import frontier as frontier_mod
+
+        return frontier_mod.frontier_main(argv[1:])
     ap = argparse.ArgumentParser("raft_tpu.bench")
     ap.add_argument("--dataset", default="sift-128-euclidean")
     ap.add_argument("--scale", type=float, default=0.01,

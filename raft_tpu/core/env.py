@@ -189,6 +189,18 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_SLO_FRESHNESS_S", "float", "300",
            "default freshness target: max age of the oldest un-compacted "
            "mutation"),
+    EnvVar("RAFT_TPU_AUTOTUNE", "bool", "unset",
+           "1 runs the closed-loop SLO autotuner on every served index "
+           "(SearchService(autotune=...) overrides)"),
+    EnvVar("RAFT_TPU_AUTOTUNE_EVAL_S", "float", "2",
+           "autotuner tick period (scaled by RAFT_TPU_SLO_WINDOW_SCALE)"),
+    EnvVar("RAFT_TPU_AUTOTUNE_RECALL_FLOOR", "float", "0.9",
+           "recall EWMA floor the autotuner must hold while trading "
+           "effort for QPS"),
+    EnvVar("RAFT_TPU_FRONTIER_PATH", "str", "unset",
+           "serialized FrontierModel (bench frontier sweep output) the "
+           "autotuner navigates; unset falls back to the synthetic "
+           "effort-ladder model"),
     EnvVar("RAFT_TPU_DISABLE_PROFILER", "bool", "unset",
            "1 disables the Perfetto capture helper"),
     EnvVar("RAFT_TPU_PERF_LEDGER", "bool", "1",

@@ -4,6 +4,7 @@ from raft_tpu.neighbors import (
     ball_cover,
     brute_force,
     cagra,
+    effort,
     extras,
     hnsw,
     ivf_flat,
@@ -22,6 +23,7 @@ __all__ = [
     "ball_cover",
     "brute_force",
     "cagra",
+    "effort",
     "extras",
     "hnsw",
     "ivf_flat",

@@ -16,7 +16,8 @@ budget like the reference sizes tiles against its workspace resource.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from dataclasses import dataclass
+from typing import ClassVar, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -255,6 +256,29 @@ def knn(
         filter_words,
     )
     return vals, idx
+
+
+@dataclass(frozen=True)
+class EffortSpec:
+    """Identity effort spec: exact search has no recall/throughput knob,
+    so every actuator level maps to the same (full) effort.  Exists so
+    the effort arbiter and frontier sweep treat all four backends
+    uniformly (see ivf_flat.EffortSpec for the contract)."""
+
+    backend: ClassVar[str] = "brute_force"
+
+    @classmethod
+    def from_params(cls, params=None, **extra) -> "EffortSpec":
+        return cls()
+
+    def apply(self, params=None):
+        return params
+
+    def degraded(self, level: int) -> "EffortSpec":
+        return self
+
+    def knobs(self):
+        return {}
 
 
 class Index:

@@ -35,8 +35,8 @@ TPU re-design
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
-from typing import Optional, Tuple
+from dataclasses import dataclass, replace as dc_replace
+from typing import ClassVar, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +107,45 @@ class SearchParams:
     rand_xor_mask: int = 0x128394  # seed for random init candidates
     num_random_samplings: int = 1
     num_entry_centers: int = 16
+
+
+@dataclass(frozen=True)
+class EffortSpec:
+    """Typed search-effort knobs for CAGRA (see ivf_flat.EffortSpec for
+    the contract): beam size ``itopk_size`` and parent count
+    ``search_width``.  The degrade ladder moves only ``itopk_size`` —
+    width 1 measured pareto-better at equal recall on this formulation
+    (see SearchParams.search_width), so the ladder never widens and the
+    warmed variant set stays one executable per (bucket, level)."""
+
+    itopk_size: int = 64
+    search_width: int = 1
+
+    backend: ClassVar[str] = "cagra"
+
+    @classmethod
+    def from_params(cls, params: Optional[SearchParams] = None,
+                    **extra) -> "EffortSpec":
+        base = params if params is not None else SearchParams()
+        return cls(itopk_size=int(base.itopk_size),
+                   search_width=int(base.search_width))
+
+    def apply(self, params: Optional[SearchParams] = None) -> SearchParams:
+        base = params if params is not None else SearchParams()
+        return dc_replace(base, itopk_size=int(self.itopk_size),
+                          search_width=int(self.search_width))
+
+    def degraded(self, level: int) -> "EffortSpec":
+        if level <= 0:
+            return self
+        return EffortSpec(
+            itopk_size=max(32, int(self.itopk_size) >> int(level)),
+            search_width=int(self.search_width),
+        )
+
+    def knobs(self):
+        return {"itopk_size": int(self.itopk_size),
+                "search_width": int(self.search_width)}
 
 
 class Index:

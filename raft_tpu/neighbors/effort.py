@@ -1,0 +1,77 @@
+"""Effort-spec dispatch: one uniform view of the four backends' typed
+search-effort knobs.
+
+Each backend module defines its own ``EffortSpec`` (ivf_flat / ivf_pq:
+``n_probes`` + ``refine_ratio`` [+ ``lut_dtype``]; cagra: ``itopk_size``
++ ``search_width``; brute_force: identity) next to its ``SearchParams``.
+This module maps a params instance — or a served index — back to the
+spec class that knows how to move it, so generic machinery (the serve
+``EffortArbiter``, the ``obs.autotune`` controller, the frontier sweep)
+never hard-codes per-backend field names.
+
+The contract every spec honors: knob values are host Python operands
+that select among *already warmed* executables (the serving warmup
+ladder precompiles one variant per (bucket, effort level)); they never
+appear as static jit arguments — the analysis RECOMPILE rule rejects
+any jit entry that marks an effort knob static.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+#: every backend's spec class, keyed by backend name
+SPECS = {
+    "brute_force": brute_force.EffortSpec,
+    "ivf_flat": ivf_flat.EffortSpec,
+    "ivf_pq": ivf_pq.EffortSpec,
+    "cagra": cagra.EffortSpec,
+}
+
+#: knob field names that must never ride as static jit arguments
+EFFORT_KNOBS = frozenset(
+    {"n_probes", "refine_ratio", "lut_dtype", "itopk_size", "search_width"}
+)
+
+_BY_PARAMS = {
+    ivf_flat.SearchParams: ivf_flat.EffortSpec,
+    ivf_pq.SearchParams: ivf_pq.EffortSpec,
+    cagra.SearchParams: cagra.EffortSpec,
+}
+
+
+def spec_class_for_params(params_cls):
+    """The EffortSpec class owning a ``SearchParams`` class, or None for
+    param types without effort semantics (hnsw, ball_cover, ...)."""
+    return _BY_PARAMS.get(params_cls)
+
+
+def spec_for_params(params, **extra):
+    """EffortSpec capturing ``params``' current knob values, or None."""
+    spec_cls = _BY_PARAMS.get(type(params))
+    return spec_cls.from_params(params, **extra) if spec_cls else None
+
+
+def spec_for_index(index) -> Optional[object]:
+    """EffortSpec for a served index: from its ``search_params`` when it
+    carries one, identity for brute-force, else None (unknown backend —
+    callers treat it as effortless)."""
+    base = getattr(index, "search_params", None)
+    if base is not None:
+        spec = spec_for_params(base)
+        if spec is not None:
+            return spec
+    kind = getattr(index, "kind", None)  # MutableIndex carries a kind tag
+    if kind in SPECS:
+        return SPECS[kind].from_params(base)
+    if type(index).__module__.endswith("brute_force"):
+        return brute_force.EffortSpec()
+    return None
+
+
+def backend_for_index(index) -> Optional[str]:
+    """Backend name ("ivf_flat", ...) for a served index, or None."""
+    spec = spec_for_index(index)
+    return spec.backend if spec is not None else None

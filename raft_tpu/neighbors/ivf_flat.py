@@ -26,8 +26,8 @@ Gram decomposition used everywhere (‖y‖² precomputed per stored vector);
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
-from typing import Optional, Tuple
+from dataclasses import dataclass, replace as dc_replace
+from typing import ClassVar, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +87,53 @@ class SearchParams:
 
     n_probes: int = 20
     strategy: str = "auto"  # auto | query_major | probe_major
+
+
+@dataclass(frozen=True)
+class EffortSpec:
+    """Typed search-effort knobs for IVF-Flat — the values an actuator
+    (overload ladder, SLO autotuner) may move at serve time.
+
+    Every knob is a host Python value that selects among *already
+    compiled* executables: the serving warmup ladder precompiles one
+    variant per (bucket, effort level), so stepping effort re-dispatches
+    a warmed executable and never appears as a new static jit argument
+    (the RECOMPILE rule enforces this).  ``refine_ratio`` is an offline
+    sweep knob — the bench harness searches ``k × ratio`` candidates and
+    exact-refines; online actuation maps only the SearchParams fields.
+    """
+
+    n_probes: int = 20
+    refine_ratio: int = 1
+
+    backend: ClassVar[str] = "ivf_flat"
+
+    @classmethod
+    def from_params(cls, params: Optional[SearchParams] = None,
+                    **extra) -> "EffortSpec":
+        base = params if params is not None else SearchParams()
+        return cls(n_probes=int(base.n_probes),
+                   refine_ratio=int(extra.get("refine_ratio", 1)))
+
+    def apply(self, params: Optional[SearchParams] = None) -> SearchParams:
+        """SearchParams carrying this spec's online knobs (non-effort
+        fields inherited from ``params``)."""
+        base = params if params is not None else SearchParams()
+        return dc_replace(base, n_probes=int(self.n_probes))
+
+    def degraded(self, level: int) -> "EffortSpec":
+        """This spec stepped down ``level`` notches of the serving effort
+        ladder: halve ``n_probes`` per level (floor 1), drop refine."""
+        if level <= 0:
+            return self
+        return EffortSpec(
+            n_probes=max(1, int(self.n_probes) >> int(level)),
+            refine_ratio=1,
+        )
+
+    def knobs(self):
+        return {"n_probes": int(self.n_probes),
+                "refine_ratio": int(self.refine_ratio)}
 
 
 class Index:

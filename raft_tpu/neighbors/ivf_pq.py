@@ -44,8 +44,8 @@ TPU re-design
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
-from typing import Optional, Tuple
+from dataclasses import dataclass, replace as dc_replace
+from typing import ClassVar, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -175,6 +175,51 @@ class SearchParams:
     lut_dtype: str = "float32"                 # float32 | bfloat16 (ref fp8/half analog)
     internal_distance_dtype: str = "float32"   # float32 | bfloat16
     strategy: str = "auto"                     # auto | query_major | probe_major
+
+
+@dataclass(frozen=True)
+class EffortSpec:
+    """Typed search-effort knobs for IVF-PQ (see ivf_flat.EffortSpec for
+    the contract): ``n_probes`` + ``lut_dtype`` actuate online through
+    SearchParams; ``refine_ratio`` is the offline sweep's exact-refine
+    multiplier.  Knob values select among warmed executables — they never
+    ride as static jit arguments."""
+
+    n_probes: int = 20
+    refine_ratio: int = 1
+    lut_dtype: str = "float32"
+
+    backend: ClassVar[str] = "ivf_pq"
+
+    @classmethod
+    def from_params(cls, params: Optional[SearchParams] = None,
+                    **extra) -> "EffortSpec":
+        base = params if params is not None else SearchParams()
+        return cls(n_probes=int(base.n_probes),
+                   refine_ratio=int(extra.get("refine_ratio", 1)),
+                   lut_dtype=str(base.lut_dtype))
+
+    def apply(self, params: Optional[SearchParams] = None) -> SearchParams:
+        base = params if params is not None else SearchParams()
+        return dc_replace(base, n_probes=int(self.n_probes),
+                          lut_dtype=str(self.lut_dtype))
+
+    def degraded(self, level: int) -> "EffortSpec":
+        """Step down ``level`` notches: halve ``n_probes`` per level
+        (floor 1), drop the LUT to bf16 at level ≥ 2 (the cheapest-scan
+        analog of disabling refine), drop refine."""
+        if level <= 0:
+            return self
+        return EffortSpec(
+            n_probes=max(1, int(self.n_probes) >> int(level)),
+            refine_ratio=1,
+            lut_dtype="bfloat16" if level >= 2 else str(self.lut_dtype),
+        )
+
+    def knobs(self):
+        return {"n_probes": int(self.n_probes),
+                "refine_ratio": int(self.refine_ratio),
+                "lut_dtype": str(self.lut_dtype)}
 
 
 def _auto_pq_dim(dim: int) -> int:

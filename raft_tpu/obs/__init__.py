@@ -48,6 +48,11 @@ into queryable state:
   version)``, hotspot ranking with measured roofline utilization, and a
   per-bucket EWMA regression detector that auto-triggers a profiler
   capture and lands inside the correlated incident.
+- :mod:`~raft_tpu.obs.autotune` — closed-loop SLO autotuner: walks each
+  served index's warmed effort ladder (through the serve
+  ``EffortArbiter``) toward max QPS subject to recall ≥ floor and a
+  healthy p99 error budget, navigating the measured QPS–recall
+  :class:`FrontierModel` a ``bench frontier`` sweep emits.
 
 Quick start::
 
@@ -83,6 +88,7 @@ from raft_tpu.obs.events import (
     publish,
     subscribe,
 )
+from raft_tpu.obs.autotune import Autotuner, FrontierModel, FrontierPoint
 from raft_tpu.obs.flight import (
     FlightRecorder,
     default_recorder,
@@ -122,6 +128,7 @@ from raft_tpu.obs.spans import (
     spans_snapshot,
 )
 from raft_tpu.obs import (
+    autotune,
     cost,
     events,
     flight,
@@ -161,11 +168,14 @@ def snapshot():
 
 __all__ = [
     "AlertPolicy",
+    "Autotuner",
     "CostReport",
     "Counter",
     "Event",
     "EventBus",
     "FlightRecorder",
+    "FrontierModel",
+    "FrontierPoint",
     "Gauge",
     "Histogram",
     "Incident",
@@ -179,6 +189,7 @@ __all__ = [
     "Span",
     "analyze_callable",
     "analyze_compiled",
+    "autotune",
     "capture_async",
     "cost",
     "current_span",

@@ -72,6 +72,10 @@ KINDS = frozenset({
     "perf_regression",
     "build_complete",
     "page_thrash",
+    # closed-loop autotuner effort moves: context, not trigger — the
+    # slo_burn (or degraded_enter) that motivated the move opens the
+    # incident; the step annotates its timeline
+    "autotune_step",
 })
 
 #: kinds that open incidents / trigger flight dumps; the rest are context

@@ -74,6 +74,9 @@ class IndexProbe:
     # overload actuators (None: no admission controller / degraded manager)
     admission_level: Optional[int] = None      # current shed pressure level
     degraded_level: Optional[int] = None       # current reduced-effort level
+    # closed-loop autotuner (None: no autotuner attached)
+    autotune_level: Optional[int] = None       # controller's effort level
+    autotune_pinned_min: bool = False          # burning with no effort left
 
 
 def _check(status: str, detail: str) -> Dict[str, str]:
@@ -190,6 +193,28 @@ def index_health(probe: IndexProbe) -> Dict[str, object]:
         )
     else:
         checks["overload"] = _check(OK, "no pressure; full-effort search")
+
+    # autotuner: like overload, reduced effort is DEGRADED by design and
+    # never UNHEALTHY — the controller is trading recall headroom for
+    # latency on purpose.  Pinned at minimum effort is the alarming
+    # shape: the latency budget is still burning and the ladder has
+    # nothing left to shed, so only an operator (capacity) can help.
+    if probe.autotune_level is None:
+        checks["autotune"] = _check(OK, "no autotuner attached")
+    elif probe.autotune_pinned_min:
+        checks["autotune"] = _check(
+            DEGRADED,
+            f"pinned at minimum effort (level {probe.autotune_level}) "
+            f"with the latency budget still burning",
+        )
+    elif probe.autotune_level > 0:
+        checks["autotune"] = _check(
+            DEGRADED,
+            f"autotuned to effort level {probe.autotune_level} "
+            f"(trading recall margin for QPS/latency)",
+        )
+    else:
+        checks["autotune"] = _check(OK, "autotuner at full effort")
 
     status = worst(*(c["status"] for c in checks.values()))
     return {"status": status, "checks": checks}

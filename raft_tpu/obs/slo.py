@@ -502,6 +502,22 @@ class SloEngine:
             ]
         return {"exhausted": exhausted, "alerting": alerting}
 
+    def paging(self) -> List[str]:
+        """Spec names with a *page-severity* burn alert currently firing.
+
+        The slice a closed-loop controller should act on: page policies
+        (short windows) re-arm as soon as the short window recovers, so
+        the signal tracks the incident edge-to-edge.  Ticket-severity
+        latches span the long window and would hold a controller in the
+        shed state long after the cause cleared.
+        """
+        page = {p.name for p in ALERT_POLICIES if p.severity == "page"}
+        with self._lock:
+            return [
+                n for n, s in self._states.items()
+                if any(s.fired.get(p, False) for p in page)
+            ]
+
     def snapshot(self) -> Dict[str, object]:
         """Provider section for registry snapshots."""
         with self._lock:

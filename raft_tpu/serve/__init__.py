@@ -50,6 +50,7 @@ books XLA cost/memory figures per bucket into the registry.  See
 from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.build import build_sharded, knn_graph_sharded
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
+from raft_tpu.serve.effort import EffortArbiter
 from raft_tpu.serve.metrics import (
     ServingMetrics,
     compile_count,
@@ -80,6 +81,7 @@ __all__ = [
     "Compactor",
     "DeadlineExceeded",
     "DegradedModeManager",
+    "EffortArbiter",
     "FilterRegistry",
     "HedgedDispatcher",
     "IndexRegistry",

@@ -229,6 +229,7 @@ class MicroBatcher:
         ragged=None,
         admission=None,
         degraded=None,
+        effort=None,
         hedger=None,
         perf_meta: Optional[Callable[[], Tuple[str, str]]] = None,
     ):
@@ -273,6 +274,11 @@ class MicroBatcher:
         # error counters the SLO availability spec reads
         self.admission = admission
         self.degraded = degraded
+        # optional serve.effort.EffortArbiter: the single effort writer
+        # (overload ladder clamp + autotuner walk) — when present its
+        # ladder supersedes degraded's for warmup, since the search fn
+        # consults the arbiter, not the manager, for effective params
+        self.effort = effort
         self.hedger = hedger
         if admission is not None and admission.metrics is None:
             admission.metrics = self.metrics
@@ -362,11 +368,12 @@ class MicroBatcher:
         # backends trace on), so every level of the ladder gets its own
         # warmup pass — a pressure-driven level flip must never compile
         # on the hot path
-        levels = (None,) if self.degraded is None else self.degraded.levels()
+        actuator = self.effort if self.effort is not None else self.degraded
+        levels = (None,) if actuator is None else actuator.levels()
         with self._dispatch_lock, trace_range("serve.warmup"):
             for level in levels:
                 pin = (nullcontext() if level is None
-                       else self.degraded.pinned(level))
+                       else actuator.pinned(level))
                 with pin:
                     for b in self.buckets():
                         dummy = np.zeros((b, self.dim), dtype=np.float32)

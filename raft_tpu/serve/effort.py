@@ -1,0 +1,138 @@
+"""Single-writer search-effort arbitration for one served index.
+
+Before this module, search effort had two independent writers racing on
+the hot path: PR 11's :class:`~raft_tpu.serve.overload.DegradedModeManager`
+(overload ladder) derived params inside the batcher, and anything else
+that wanted to move effort had to overwrite the same ``SearchParams``
+last-writer-wins.  The :class:`EffortArbiter` closes that hole:
+
+- exactly **one** place computes the effective effort level and the
+  derived ``SearchParams`` the dispatch uses (``apply(index)``);
+- the SLO autotuner (:mod:`raft_tpu.obs.autotune`) is the only *writer*
+  (``set_autotune_level``);
+- the overload ladder is a **clamp, not a second writer**: its shed
+  level is read at apply time and floors the effective effort reduction,
+  so a load spike can always force effort down but can never fight the
+  autotuner over the same field.
+
+Effective level = ``max(autotune level, overload shed level)``, capped
+at the warmed ladder depth.  Derived params are identity-cached per
+``(base params, level)`` — the same object feeds the jit cache every
+dispatch — and every level in ``levels()`` is precompiled by the
+batcher's warmup ladder, so moving effort re-dispatches an already
+compiled variant (zero post-warmup recompiles; knob values never ride
+as static jit args — the RECOMPILE rule enforces this).
+
+Lock discipline: one leaf lock guarding the arbiter's own fields only —
+never held across the degraded manager's lock, event publication, or
+param derivation (LOCKORDER-clean by construction).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+from raft_tpu.core.trace import traced
+from raft_tpu.serve.overload import OverloadConfig, derive_degraded_params
+
+
+class EffortArbiter:
+    """Arbitrates every actuator's search-effort intent for one index
+    into a single effective ladder level and one derived params object.
+    """
+
+    def __init__(self, degraded=None, *, max_level: Optional[int] = None,
+                 name: str = "default"):
+        self.name = name
+        #: overload ladder read as a clamp (may be None: no overload
+        #: protection configured)
+        self.degraded = degraded
+        if max_level is None:
+            cfg = degraded.config if degraded is not None \
+                else OverloadConfig.from_env()
+            max_level = cfg.max_degrade_level
+        self.max_level = int(max_level)
+        self._lock = threading.Lock()  # leaf lock: own fields only
+        self._autotune_level = 0
+        self._pin: Optional[int] = None
+        self._derived: Dict[Tuple[int, int], object] = {}
+
+    # -- ladder ---------------------------------------------------------
+
+    def levels(self) -> Tuple[int, ...]:
+        """Every effort level warmup must precompile (0 … max)."""
+        return tuple(range(self.max_level + 1))
+
+    @contextmanager
+    def pinned(self, level: int):
+        """Force an effective level, bypassing both writers (warmup
+        ladders, tests)."""
+        with self._lock:
+            prev, self._pin = self._pin, int(level)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._pin = prev
+
+    # -- the single writer ---------------------------------------------
+
+    @property
+    def autotune_level(self) -> int:
+        with self._lock:
+            return self._autotune_level
+
+    def set_autotune_level(self, level: int) -> int:
+        """The autotuner's intent — the one mutating entry point.
+        Clamped to the warmed ladder; returns the stored level."""
+        level = max(0, min(int(level), self.max_level))
+        with self._lock:
+            self._autotune_level = level
+        return level
+
+    # -- reads ----------------------------------------------------------
+
+    def effective_level(self) -> int:
+        """Arbitrated level: autotune intent floored by the overload
+        shed level (clamp semantics), capped at the warmed ladder."""
+        with self._lock:
+            if self._pin is not None:
+                return self._pin
+            level = self._autotune_level
+        if self.degraded is not None:
+            level = max(level, self.degraded.level)
+        return min(level, self.max_level)
+
+    @traced("serve.effort.apply")
+    def apply(self, index):
+        """The search params the arbitrated level prescribes for
+        ``index``, or None at full effort (callers fall back to the
+        index's own).  Identity-cached per (base params, level) so the
+        jit cache sees a stable object every dispatch."""
+        level = self.effective_level()
+        if level <= 0:
+            return None
+        base = getattr(index, "search_params", None)
+        if base is None:
+            return None
+        key = (id(base), level)
+        with self._lock:
+            derived = self._derived.get(key)
+        if derived is None:
+            derived = derive_degraded_params(base, level)
+            with self._lock:
+                self._derived[key] = derived
+        return derived
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            autotune = self._autotune_level
+        return {
+            "autotune_level": autotune,
+            "degraded_level": self.degraded.level
+            if self.degraded is not None else 0,
+            "effective_level": self.effective_level(),
+            "max_level": self.max_level,
+        }

@@ -348,13 +348,20 @@ class AdmissionController:
 
 def derive_degraded_params(params, level: int):
     """Reduced-effort variant of a backend ``SearchParams`` at a
-    degradation level: halve ``n_probes`` (ivf_flat / ivf_pq) and
-    cagra's ``itopk_size`` per level, and drop ivf_pq's LUT to bf16 at
-    level ≥ 2 (the closest analog to disabling refine — cheaper inner
-    scan, slightly worse recall).  Unknown param types pass through
-    unchanged (brute_force has no effort knob)."""
+    degradation level.  The semantics live with each backend's typed
+    :class:`~raft_tpu.neighbors.effort.EffortSpec` (``degraded(level)``):
+    halve ``n_probes`` (ivf_flat / ivf_pq) and cagra's ``itopk_size``
+    per level, drop ivf_pq's LUT to bf16 at level ≥ 2.  Param types
+    without an EffortSpec fall back to a field-name walk with the same
+    rules; fully unknown types pass through unchanged (brute_force has
+    no effort knob)."""
     if level <= 0 or params is None:
         return params
+    from raft_tpu.neighbors import effort as _effort  # lazy: serve is importable without the backends
+
+    spec = _effort.spec_for_params(params)
+    if spec is not None:
+        return spec.degraded(level).apply(params)
     try:
         names = {f.name for f in dc_fields(params)}
     except TypeError:

@@ -190,7 +190,8 @@ class RaggedSearcher:
     """
 
     def __init__(self, service, name: str, spec: RaggedSpec,
-                 filters: Optional[FilterRegistry], degraded=None):
+                 filters: Optional[FilterRegistry], degraded=None,
+                 effort=None):
         self._service = service
         self._name = name
         self._spec = spec
@@ -198,6 +199,10 @@ class RaggedSearcher:
         # optional serve.overload.DegradedModeManager: under sustained
         # pressure its level prescribes reduced-effort search params
         self._degraded = degraded
+        # optional serve.effort.EffortArbiter: when present it is the
+        # single source of the effective effort level (overload clamp +
+        # autotuner walk) and supersedes the direct degraded lookup
+        self._effort = effort
 
     @property
     def filters(self) -> Optional[FilterRegistry]:
@@ -239,7 +244,12 @@ class RaggedSearcher:
             select_min = DISTANCE_TYPES[index.metric] != "inner_product"
             return mask_row_k(dist, ids, row_k, select_min=select_min)
         search_params = None
-        if self._degraded is not None:
+        if self._effort is not None:
+            # arbitrated effort level (overload clamp + autotuner); every
+            # (bucket, level) variant was warmed by the batcher's
+            # level-pinned warmup
+            search_params = self._effort.apply(index)
+        elif self._degraded is not None:
             # reduced-effort params under pressure; every (bucket, level)
             # variant was warmed by the batcher's level-pinned warmup
             search_params = self._degraded.params_for(index)

@@ -36,6 +36,7 @@ from typing import Dict, Optional, Sequence, Union
 from raft_tpu import obs
 from raft_tpu.core import env as _env
 from raft_tpu.core.trace import traced
+from raft_tpu.obs import autotune as obs_autotune
 from raft_tpu.obs import cost as obs_cost
 from raft_tpu.obs import health as obs_health
 from raft_tpu.obs import incidents as obs_incidents
@@ -44,6 +45,7 @@ from raft_tpu.obs import slo as obs_slo
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
+from raft_tpu.serve.effort import EffortArbiter
 from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
 from raft_tpu.serve.overload import (
@@ -56,6 +58,19 @@ from raft_tpu.serve.ragged import FilterRegistry, RaggedSearcher, RaggedSpec
 from raft_tpu.serve.registry import IndexRegistry
 from raft_tpu.serve.replica import ReplicaGroup
 from raft_tpu.serve.shard import ShardedIndex
+
+
+class _AuditorTap:
+    """Late-bound recall tap for the autotuner: reads the service's
+    *current* auditor per call, so :meth:`SearchService.attach_auditor`
+    takes effect on already-watched indexes."""
+
+    def __init__(self, service: "SearchService"):
+        self._service = service
+
+    def recall_ewma(self, name: str) -> Optional[float]:
+        auditor = self._service.auditor
+        return auditor.recall_ewma(name) if auditor is not None else None
 
 
 class SearchService:
@@ -80,6 +95,7 @@ class SearchService:
         ] = None,
         ragged: Union[None, bool, RaggedSpec] = None,
         overload: Union[None, bool, OverloadConfig] = None,
+        autotune: Union[None, bool, obs_autotune.Autotuner] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -133,6 +149,25 @@ class SearchService:
         self._admission: Dict[str, AdmissionController] = {}
         self._degraded: Dict[str, DegradedModeManager] = {}
         self._hedgers: Dict[str, HedgedDispatcher] = {}
+        # autotune=None: RAFT_TPU_AUTOTUNE decides.  True: controller
+        # from env (frontier via RAFT_TPU_FRONTIER_PATH).  A prebuilt
+        # Autotuner is adopted as-is (caller owns its start state).
+        # Every added index gets an EffortArbiter — the single writer of
+        # effective search effort: the autotuner steps its level, the
+        # overload ladder clamps it, and the dispatch reads exactly one
+        # arbitrated SearchParams (local dispatch only — the replica
+        # path has no params leg).
+        self.autotuner: Optional[obs_autotune.Autotuner] = None
+        if isinstance(autotune, obs_autotune.Autotuner):
+            self.autotuner = autotune
+        else:
+            if autotune is None:
+                autotune = _env.env_bool("RAFT_TPU_AUTOTUNE", False)
+            if autotune:
+                self.autotuner = obs_autotune.Autotuner()
+                if start:
+                    self.autotuner.start()
+        self._effort: Dict[str, EffortArbiter] = {}
         self._start = start
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -212,7 +247,7 @@ class SearchService:
                 f"{self.ragged.k_max}"
             )
         version = self.registry.register(name, index)
-        admission = degraded = hedger = None
+        admission = degraded = hedger = effort = None
         if self.overload is not None:
             admission = AdmissionController(self.overload, name=name)
             if self.replicas is None:
@@ -224,18 +259,28 @@ class SearchService:
                     self.replicas.member_searchers(name, k),
                     self.overload, name=name,
                 )
+        if self.replicas is None and (
+            degraded is not None or self.autotuner is not None
+        ):
+            # the single effort-arbitration point: the dispatch reads
+            # effective params from here (degraded shed level = clamp,
+            # autotuner = writer); plain services skip it entirely
+            effort = EffortArbiter(degraded, name=name)
         with self._lock:
             self._ks[name] = k
             old = self._batchers.pop(name, None)
             old_admission = self._admission.pop(name, None)
             self._degraded.pop(name, None)
             self._hedgers.pop(name, None)
+            self._effort.pop(name, None)
             if admission is not None:
                 self._admission[name] = admission
             if degraded is not None:
                 self._degraded[name] = degraded
             if hedger is not None:
                 self._hedgers[name] = hedger
+            if effort is not None:
+                self._effort[name] = effort
             if self.ragged is not None:
                 freg = None
                 if self.ragged.filters and isinstance(index, MutableIndex):
@@ -250,7 +295,8 @@ class SearchService:
                     freg = FilterRegistry(max(1, index.size))
                 self._filter_regs[name] = freg
                 search_fn = RaggedSearcher(
-                    self, name, self.ragged, freg, degraded=degraded
+                    self, name, self.ragged, freg, degraded=degraded,
+                    effort=effort,
                 )
             else:
                 search_fn = self._make_search_fn(name, k)
@@ -269,6 +315,7 @@ class SearchService:
                 admission=admission,
                 degraded=degraded,
                 hedger=hedger,
+                effort=effort,
                 perf_meta=self._make_perf_meta(name),
             )
             self._batchers[name] = batcher
@@ -278,9 +325,22 @@ class SearchService:
             old_admission.close()
         if self.slo_engine is not None and self._slo_auto and old is None:
             self.slo_engine.watch_index(name)
+        if self.autotuner is not None and effort is not None:
+            self.autotuner.watch_index(
+                name, effort, index=index,
+                auditor=_AuditorTap(self),
+                slo=self.slo_engine,
+                perf=obs_perf.default_ledger(),
+            )
         if warmup:
             batcher.warmup()
         return version
+
+    def effort_arbiter(self, name: str) -> Optional[EffortArbiter]:
+        """The index's effort-arbitration point (None: plain service with
+        neither overload degraded mode nor an autotuner)."""
+        with self._lock:
+            return self._effort.get(name)
 
     def _make_search_fn(self, name: str, k: int):
         def search_fn(queries):
@@ -289,12 +349,13 @@ class SearchService:
             index, _version = self.registry.get_versioned(name)
             if self.replicas is not None:
                 return self.replicas.search(name, queries, k)
-            mgr = self._degraded.get(name)
-            if mgr is not None and isinstance(index, MutableIndex):
-                params = mgr.params_for(index)
+            arb = self._effort.get(name)
+            if arb is not None and isinstance(index, MutableIndex):
+                params = arb.apply(index)
                 if params is not None:
-                    # reduced-effort params under pressure; warmed per
-                    # level by the batcher's level-pinned warmup
+                    # arbitrated reduced-effort params (autotuner level
+                    # clamped by the overload ladder); warmed per level
+                    # by the batcher's level-pinned warmup
                     return index.search(queries, k, search_params=params)
             return index.search(queries, k)
 
@@ -401,12 +462,15 @@ class SearchService:
             admission = self._admission.pop(name, None)
             self._degraded.pop(name, None)
             self._hedgers.pop(name, None)
+            self._effort.pop(name, None)
         batcher.stop()
         if admission is not None:
             admission.close()
         self.registry.unregister(name)
         if self.slo_engine is not None and self._slo_auto:
             self.slo_engine.unwatch_index(name)
+        if self.autotuner is not None:
+            self.autotuner.unwatch_index(name)
 
     def names(self):
         return self.registry.names()
@@ -552,6 +616,12 @@ class SearchService:
         mgr = self._degraded.get(name)
         if mgr is not None:
             out["degraded_level"] = mgr.level
+        arb = self._effort.get(name)
+        if arb is not None:
+            out.update(
+                autotune_level=arb.autotune_level,
+                effective_effort_level=arb.effective_level(),
+            )
         hedger = self._hedgers.get(name)
         if hedger is not None:
             out.update(
@@ -606,6 +676,8 @@ class SearchService:
         ctx: Dict[str, object] = {"indexes": indexes}
         if self.slo_engine is not None:
             ctx["slo"] = self.slo_engine.health()
+        if self.autotuner is not None:
+            ctx["autotune"] = self.autotuner.health()
         return ctx
 
     def healthz(self) -> Dict[str, object]:
@@ -637,6 +709,10 @@ class SearchService:
         """
         self._refresh_capacity_gauges()
         auditor = self.auditor
+        pinned_min = (
+            set(self.autotuner.health().get("pinned_min_effort", ()))
+            if self.autotuner is not None else set()
+        )
         probes: Dict[str, obs_health.IndexProbe] = {}
         for name in self.names():
             try:
@@ -663,6 +739,12 @@ class SearchService:
                     ctrl.last_level if ctrl is not None else None
                 ),
                 degraded_level=mgr.level if mgr is not None else None,
+                autotune_level=(
+                    self._effort[name].autotune_level
+                    if self.autotuner is not None and name in self._effort
+                    else None
+                ),
+                autotune_pinned_min=name in pinned_min,
                 recall_ewma=(
                     auditor.recall_ewma(name) if auditor is not None else None
                 ),
@@ -755,6 +837,9 @@ class SearchService:
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:
+        # autotuner before the SLO engine: its ticks read slo health
+        if self.autotuner is not None:
+            self.autotuner.stop()
         if self.slo_engine is not None:
             self.slo_engine.stop()
         try:

@@ -24,6 +24,12 @@ def concretize(x):
     return int(x)  # raft-tpu: ignore[RECOMPILE] suppression control
 
 
+@functools.partial(jax.jit, static_argnames=("n_probes",))
+def probe_static(x, n_probes):
+    # effort knob marked static: recompiles per autotune level
+    return x[:, :1] * n_probes
+
+
 def make_adder():
     extras = []
 

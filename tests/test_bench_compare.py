@@ -754,3 +754,50 @@ def test_distributed_build_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_autotune_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the closed-loop autotune A/B: run ``bench.py
+    autotune`` (paced ivf_flat serving, SLO burn injected mid-run, one
+    arm with the Autotuner attached and one without) and gate it with
+    ``bench.py compare`` against the frozen record.  The leg
+    self-asserts the control-loop story; here we re-pin the load-bearing
+    facts: the tuner sheds effort and restores p99 within its window,
+    recall never dips below the floor, effort actuation never
+    recompiles, and the slo_burn -> autotune_step chain landed in one
+    incident."""
+    candidate = str(tmp_path / "autotune_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "autotune"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recall"] >= 0.9, "recall dipped below the floor"
+    assert line["recompiles"] == 0, "effort actuation recompiled"
+    assert line["restored_within_ticks"] <= 4, (
+        "p99 not restored within the controller window"
+    )
+    on = line["autotune_on"]
+    assert on["max_level"] > 0, "autotuner never shed effort under burn"
+    assert on["final_level"] == 0, "autotuner never climbed back to full effort"
+    assert on["recompiles"] == 0 and line["autotune_off"]["recompiles"] == 0
+    chain = line["incident_chain"]
+    assert chain["trigger"] == "slo_burn"
+    assert chain["autotune_steps"] >= 1, (
+        "no autotune_step correlated into the burn incident"
+    )
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_autotune_r18.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

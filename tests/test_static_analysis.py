@@ -77,7 +77,7 @@ def test_discovery_is_not_vacuous(clean_result):
     assert stats["lockorder_locks"] >= 10, stats
     assert stats["envreg_known_vars"] >= 30, stats
     assert stats["traced_entry_points"] >= 25, stats
-    assert stats["traced_serve_entries_checked"] == 23, stats
+    assert stats["traced_serve_entries_checked"] == 25, stats
     assert stats["traced_batcher_classes"] == 1, stats
     assert stats["recompile_descriptor_entries"] == 4, stats
     # kernel dispatch attribution: every routed leg stamps from the
@@ -106,6 +106,13 @@ def test_recompile_rule(fixture_result):
     assert "badpkg.ops.matrix.mask_row_k" in symbols, findings
     # static_argnames negative control must stay quiet
     assert not any("gate_static" in f.symbol for f in findings), findings
+    # effort knobs are operands by contract — marking one static is a
+    # finding even without value-dependent control flow
+    assert any(
+        f.symbol == "badpkg.jits.probe_static"
+        and "effort knob" in f.message
+        for f in findings
+    ), findings
     # `row_k is None` structure test is a laundered negative control
     assert not any(
         f.symbol == "badpkg.ops.matrix.select_k" for f in findings
@@ -113,6 +120,16 @@ def test_recompile_rule(fixture_result):
     assert any(s.symbol == "badpkg.jits.concretize" for s in suppressed), (
         suppressed
     )
+
+
+def test_effort_knob_vocab_in_sync():
+    """The checker is stdlib-only so it mirrors the knob vocabulary;
+    drifting from the runtime source of truth would let a new backend's
+    knob ride static unflagged."""
+    from raft_tpu.analysis.checkers.recompile import EFFORT_KNOB_NAMES
+    from raft_tpu.neighbors.effort import EFFORT_KNOBS
+
+    assert EFFORT_KNOB_NAMES == EFFORT_KNOBS
 
 
 def test_hostsync_rule(fixture_result):

@@ -54,7 +54,7 @@ def test_serve_surface_discovery_is_not_vacuous(result):
     # trio, the perf-ledger pair, the sharded rebuild, the two
     # module-level build entry points, and the page-store pager trio)
     # checked, against exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 23, result.stats
+    assert result.stats["traced_serve_entries_checked"] == 25, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
     assert result.stats["traced_labels"] >= 23, result.stats
 
