@@ -210,6 +210,9 @@ def main() -> None:
     if "slo" in sys.argv[1:]:
         run_slo_leg()
         return
+    if "explain" in sys.argv[1:]:
+        run_explain_leg()
+        return
     if "autotune" in sys.argv[1:]:
         run_autotune_leg()
         return
@@ -1714,6 +1717,155 @@ def run_flight_leg() -> None:
             "pipeline_depth": depth,
             "recorder_on": on,
             "recorder_off": off,
+            "qps_ratio": ratio,
+            "overhead_pct": (
+                round((1.0 - ratio) * 100.0, 2) if ratio else None
+            ),
+            "recompiles": on["recompiles"] + off["recompiles"],
+            "requests": n_requests,
+            "n": n,
+        }
+    )
+
+
+def run_explain_leg() -> None:
+    """``python bench.py explain`` — explain tail-sampling overhead A/B
+    (CPU).
+
+    Same paced-device serve workload as ``run_flight_leg`` at pipeline
+    depth 2, run once with explain collection off (the default:
+    ``RAFT_TPU_EXPLAIN`` unset, so the batcher takes no stamps and the
+    archive sees nothing) and once with ``RAFT_TPU_EXPLAIN=1`` —
+    always-on tail sampling scanning every completed batch and archiving
+    the interesting tail.  The headline value is the sampling-on QPS;
+    ``qps_ratio`` (on/off) is the cost of "always-on" — the acceptance
+    bar is within 2% on quiet hardware with **zero** post-warmup
+    recompiles on both arms (the sampler rides host-side stamps, never
+    executable outputs), and the frozen record in ``benchmarks/`` gates
+    regressions via ``bench.py compare``.
+    """
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import explain, flight, slowlog
+    from raft_tpu.serve.batcher import MicroBatcher
+    from raft_tpu.serve.metrics import ServingMetrics
+
+    n, d, k = 8192, 64, 10
+    n_requests, n_clients, depth = 2048, 4, 2
+    device_ms = float(os.environ.get("RAFT_TPU_BENCH_DEVICE_MS", "10"))
+    slowlog.configure(None)  # open-loop flood: queue waits are the workload
+    rng = np.random.default_rng(0)
+    dataset = rng.random((n, d), dtype=np.float32)
+    queries = rng.random((n_requests, d), dtype=np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), dataset)
+    params = ivf_flat.SearchParams(n_probes=8)
+
+    class _Paced:
+        __slots__ = ("arr", "deadline")
+
+        def __init__(self, arr, deadline: float):
+            self.arr = arr
+            self.deadline = deadline
+
+        def block_until_ready(self):
+            jax.block_until_ready(self.arr)
+            rest = self.deadline - time.perf_counter()
+            if rest > 0:
+                time.sleep(rest)  # releases the GIL, like a TPU RPC
+            return self
+
+        def __array__(self, dtype=None):
+            a = np.asarray(self.arr)
+            return a if dtype is None else a.astype(dtype)
+
+    def make_paced_search():
+        lock = threading.Lock()
+        state = {"free": 0.0}
+
+        def search_fn(batch):
+            dist, ids = ivf_flat.search(params, index, batch, k)
+            with lock:
+                start = max(time.perf_counter(), state["free"])
+                state["free"] = deadline = start + device_ms * 1e-3
+            return _Paced(dist, deadline), _Paced(ids, deadline)
+
+        return search_fn
+
+    def run_arm(name: str) -> dict:
+        flight.reset()
+        explain.reset()  # clears the ring and re-reads RAFT_TPU_EXPLAIN_*
+        batcher = MicroBatcher(
+            make_paced_search(), d, max_batch=32, max_delay_ms=0.5,
+            metrics=ServingMetrics(name=f"bench_explain_{name}"),
+            pipeline_depth=depth,
+        )
+        batcher.warmup()
+
+        def client(cid: int):
+            futs = [
+                batcher.submit(queries[i])
+                for i in range(cid, n_requests, n_clients)
+            ]
+            for f in futs:
+                f.result(timeout=300)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        st = batcher.metrics.snapshot()
+        archived = explain.default_archive().snapshot()["archived_total"]
+        batcher.stop()
+        return {
+            "qps": round(n_requests / wall, 1),
+            "p50_ms": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+            "p99_ms": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+            "batches": st["batches"],
+            "recompiles": st["recompiles"],
+            "archived_plans": archived,
+        }
+
+    run_arm("warm")  # discarded: one-time jit/thread warmth must not bias
+    os.environ.pop("RAFT_TPU_EXPLAIN", None)
+    off = run_arm("off")
+    os.environ["RAFT_TPU_EXPLAIN"] = "1"
+    try:
+        on = run_arm("on")
+    finally:
+        os.environ.pop("RAFT_TPU_EXPLAIN", None)
+    assert on["archived_plans"] > 0, (
+        "sampling-on arm archived no plans — the tail sampler never ran"
+    )
+    assert off["archived_plans"] == 0, (
+        "sampling-off arm archived plans — the RAFT_TPU_EXPLAIN gate leaks"
+    )
+    assert on["recompiles"] == 0 and off["recompiles"] == 0, (
+        "explain sampling recompiled post-warmup"
+    )
+    ratio = round(on["qps"] / off["qps"], 4) if off["qps"] else None
+    _emit(
+        {
+            "metric": f"serve_explain_sampling_qps_ivf_flat_n{n // 1000}k_k{k}",
+            "value": on["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "device_ms": device_ms,
+            "pipeline_depth": depth,
+            "sampling_on": on,
+            "sampling_off": off,
             "qps_ratio": ratio,
             "overhead_pct": (
                 round((1.0 - ratio) * 100.0, 2) if ratio else None

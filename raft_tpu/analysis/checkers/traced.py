@@ -49,6 +49,7 @@ API_PACKAGES = ("neighbors", "cluster")
 #: additions to the serve API surface belong on this list
 SERVE_ENTRY_POINTS = {
     ("serve.service.SearchService", "search"): "serve.search",
+    ("serve.service.SearchService", "explain"): "serve.explain",
     ("serve.service.SearchService", "swap"): "serve.swap",
     ("serve.service.SearchService", "warmup"): "serve.warmup",
     ("serve.service.SearchService", "flush"): "serve.flush",
@@ -73,6 +74,8 @@ SERVE_ENTRY_POINTS = {
     ("store.tiered.TieredStore", "ensure_resident"): "store.pager.ensure",
     ("store.tiered.TieredStore", "prefetch"): "store.pager.prefetch",
     ("store.tiered.TieredStore", "evict"): "store.pager.evict",
+    ("obs.explain.QueryArchive", "record"): "explain.record",
+    ("obs.explain.QueryArchive", "dump"): "explain.dump",
 }
 
 #: module-level (function) serve entry points and their span labels —

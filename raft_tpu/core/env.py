@@ -159,6 +159,16 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_FLIGHT_DEBOUNCE_S", "float", "60",
            "minimum seconds between auto-dumps; suppressed triggers are "
            "counted"),
+    EnvVar("RAFT_TPU_EXPLAIN", "bool", "unset",
+           "1 enables always-on explain tail sampling (the QueryArchive "
+           "retains full plans for the interesting tail; deep explains "
+           "work without it)"),
+    EnvVar("RAFT_TPU_EXPLAIN_ARCHIVE_CAP", "int", "128",
+           "query-archive ring size (archived ExplainPlans; oldest "
+           "evicted first)"),
+    EnvVar("RAFT_TPU_EXPLAIN_TAIL_PER_WINDOW", "int", "4",
+           "slowest-N requests the explain tail sampler keeps per "
+           "one-second window"),
     EnvVar("RAFT_TPU_EVENTS_RING", "int", "256",
            "obs event-bus recent-events ring capacity (overflow is "
            "counted, never blocking)"),

@@ -566,9 +566,11 @@ def paged_lists_for_search(index, queries, metric: str, n_probes: int):
     executable) — one tiny [q, L] GEMM, cheap next to the list scan, and
     the price of keeping the scan executables byte-identical to the
     monolithic arm."""
+    from raft_tpu.obs import explain as _explain
     from raft_tpu.store.paged import PagedLists, pages_for_lists
 
     tiered = index.paged
+    explain_on = _explain.enabled()
     if tiered.slots == tiered.n_pages:
         # fully-resident pool: pin the identity mapping once and skip the
         # per-dispatch coarse/residency bookkeeping entirely — nothing can
@@ -577,11 +579,31 @@ def paged_lists_for_search(index, queries, metric: str, n_probes: int):
         # percent of the monolithic control (bench.py paged).
         tiered.pin_identity()
         pool, page_slot = tiered.view()
+        if explain_on:
+            _explain.stamp_page_stats({
+                "pager": tiered.name, "pinned": True,
+                "hits": 0, "misses": 0,
+            })
         return PagedLists(pool, page_slot, tiered.pages_per_list)
     probes = _coarse_probes_jit(queries, index.centers, metric, n_probes)
     lists = np.unique(np.asarray(probes))  # raft-tpu: ignore[HOSTSYNC] prefetch keying needs the probed lists on host before dispatch
     pages = pages_for_lists(lists, tiered.pages_per_list)
+    h0 = m0 = 0
+    if explain_on:
+        # bracket the pager calls with the counters this dispatch already
+        # maintains — the deltas are THIS batch's page attribution (no
+        # extra syncs: `lists` is the host array computed above either way)
+        h0, m0, _ = tiered.counters()
     tiered.prefetch(pages)
     tiered.ensure_resident(pages)
+    if explain_on:
+        h1, m1, resident = tiered.counters()
+        _explain.stamp_page_stats({
+            "pager": tiered.name, "pinned": False,
+            "probed_lists": int(lists.size),
+            "pages": int(pages.size),
+            "hits": h1 - h0, "misses": m1 - m0,
+            "resident": resident,
+        })
     pool, page_slot = tiered.view()
     return PagedLists(pool, page_slot, tiered.pages_per_list)

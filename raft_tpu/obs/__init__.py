@@ -53,6 +53,11 @@ into queryable state:
   ``EffortArbiter``) toward max QPS subject to recall ≥ floor and a
   healthy p99 error budget, navigating the measured QPS–recall
   :class:`FrontierModel` a ``bench frontier`` sweep emits.
+- :mod:`~raft_tpu.obs.explain` — per-query EXPLAIN plans: on-demand
+  deep explains (``SearchService.explain``) joined from the existing
+  instruments, plus an always-on tail-sampled :class:`QueryArchive`
+  that retains full plans for the interesting tail and dumps alongside
+  flight records into the correlated incident timeline.
 
 Quick start::
 
@@ -87,6 +92,13 @@ from raft_tpu.obs.events import (
     events_snapshot,
     publish,
     subscribe,
+)
+from raft_tpu.obs.explain import (
+    ExplainPlan,
+    QueryArchive,
+    TailSampler,
+    default_archive,
+    explain_snapshot,
 )
 from raft_tpu.obs.autotune import Autotuner, FrontierModel, FrontierPoint
 from raft_tpu.obs.flight import (
@@ -131,6 +143,7 @@ from raft_tpu.obs import (
     autotune,
     cost,
     events,
+    explain,
     flight,
     health,
     incidents,
@@ -157,6 +170,7 @@ def install() -> None:
     reg.register_provider("slow_queries", slowlog_snapshot)
     reg.register_provider("flight", flight_snapshot)
     reg.register_provider("perf", ledger_snapshot)
+    reg.register_provider("explain", explain_snapshot)
     events.default_bus()
 
 
@@ -173,6 +187,7 @@ __all__ = [
     "Counter",
     "Event",
     "EventBus",
+    "ExplainPlan",
     "FlightRecorder",
     "FrontierModel",
     "FrontierPoint",
@@ -184,21 +199,26 @@ __all__ = [
     "MetricsRegistry",
     "PerfLedger",
     "QualityAuditor",
+    "QueryArchive",
     "SloEngine",
     "SloSpec",
     "Span",
+    "TailSampler",
     "analyze_callable",
     "analyze_compiled",
     "autotune",
     "capture_async",
     "cost",
     "current_span",
+    "default_archive",
     "default_bus",
     "default_ledger",
     "default_recorder",
     "default_registry",
     "events",
     "events_snapshot",
+    "explain",
+    "explain_snapshot",
     "finish_span",
     "flight",
     "health",

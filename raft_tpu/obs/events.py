@@ -76,6 +76,10 @@ KINDS = frozenset({
     # slo_burn (or degraded_enter) that motivated the move opens the
     # incident; the step annotates its timeline
     "autotune_step",
+    # query-archive dump written for an incident trigger: context — the
+    # trigger itself opened the incident; this links the artifact into
+    # its timeline
+    "explain_dump",
 })
 
 #: kinds that open incidents / trigger flight dumps; the rest are context
@@ -307,6 +311,7 @@ def _install_default_subscribers(bus: EventBus) -> None:
     # Deferred imports: flight/incidents import this module's registry
     # sibling, so wiring at bus-creation time (not module import time)
     # keeps the obs package cycle-free.
+    from raft_tpu.obs import explain as _explain
     from raft_tpu.obs import flight as _flight
     from raft_tpu.obs import incidents as _incidents
     from raft_tpu.obs import perf as _perf
@@ -314,10 +319,15 @@ def _install_default_subscribers(bus: EventBus) -> None:
     # order matters: the flight dumper and the perf auto-capture run
     # before the incident manager so the dump AND the profiler capture
     # are fresh when the incident correlating the same event attaches
-    # its evidence
+    # its evidence; the query-archive dumper runs *after* the incident
+    # manager so its reentrant ``explain_dump`` context publish finds
+    # the incident the trigger just opened (earlier, the nested fan-out
+    # would reach the incident manager before the trigger itself and
+    # the artifact link would be dropped)
     _flight.install_bus_subscriber(bus)
     _perf.install_bus_subscriber(bus)
     _incidents.install(bus)
+    _explain.install_bus_subscriber(bus)
     default_registry().register_provider("events", bus.snapshot)
 
 
@@ -381,3 +391,6 @@ def reset() -> None:
     perf = sys.modules.get("raft_tpu.obs.perf")
     if perf is not None:
         perf._on_bus_reset()
+    explain = sys.modules.get("raft_tpu.obs.explain")
+    if explain is not None:
+        explain._on_bus_reset()

@@ -115,6 +115,7 @@ class Incident:
         self.context_close: Optional[Dict[str, object]] = None
         self.flight: Optional[Dict[str, object]] = None
         self.capture: Optional[Dict[str, object]] = None
+        self.archive: Optional[Dict[str, object]] = None
         self.last_event_mono = time.monotonic()
         self.last_event_t = trigger.t
 
@@ -135,6 +136,7 @@ class Incident:
             "context_close": self.context_close,
             "flight": self.flight,
             "capture": self.capture,
+            "archive": self.archive,
         }
 
     def summary(self) -> Dict[str, object]:
@@ -148,6 +150,7 @@ class Incident:
             "events": len(self.timeline),
             "flight": (self.flight or {}).get("path"),
             "capture": (self.capture or {}).get("path"),
+            "archive": (self.archive or {}).get("path"),
         }
 
     def trace_events(self) -> List[Dict[str, object]]:
@@ -293,6 +296,15 @@ class IncidentManager:
         inc.last_event_t = event.t
         if event.recovered and inc.recovered_unix is None:
             inc.recovered_unix = event.unix_time
+        if event.kind == "explain_dump" and inc.archive is None:
+            # the query-archive subscriber runs after us in bus order and
+            # publishes this context event right after writing the dump,
+            # so the artifact is this incident's by construction
+            inc.archive = {
+                "path": event.fields.get("path"),
+                "reason": event.reason,
+                "unix_time": event.unix_time,
+            }
         self._attach_flight_locked(inc, event, dump)
         self._attach_capture_locked(inc, event, capture)
 

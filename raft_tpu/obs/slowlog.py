@@ -87,12 +87,22 @@ def maybe_record(span: Span, *, latency_s: Optional[float] = None,
     stages = ", ".join(
         f"{k}={v:.1f}ms" for k, v in entry.get("stages_ms", {}).items()
     )
+    # explain summary, when the batcher enriched the detail (the fields
+    # ride the entry either way; the line is what an operator greps):
+    # effort level + who set it, kernel path, bucket, page hit ratio
+    summary = ", ".join(
+        f"{key}={entry[key]}"
+        for key in ("effort_level", "effort_source", "kernel_path",
+                    "bucket", "page_hit_ratio")
+        if entry.get(key) is not None
+    )
     _child_logger("obs.slowlog").warning(
-        "slow query: %s took %.1fms (threshold %.1fms)%s",
+        "slow query: %s took %.1fms (threshold %.1fms)%s%s",
         span.name,
         latency_s * 1e3,
         _threshold_s * 1e3,
         f" [{stages}]" if stages else "",
+        f" [{summary}]" if summary else "",
     )
     return True
 

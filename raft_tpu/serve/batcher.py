@@ -79,6 +79,7 @@ from raft_tpu.core.trace import trace_range
 from raft_tpu import kernels as _kernels
 from raft_tpu.kernels.toolkit import next_pow2
 from raft_tpu.obs import events as obs_events
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.obs import flight, slowlog, spans
 from raft_tpu.obs import perf as obs_perf
 from raft_tpu.serve.metrics import ServingMetrics, compile_count
@@ -126,7 +127,7 @@ class _InFlight:
         "batch", "padded", "n", "bucket", "queue_waits", "t_pad",
         "inflight_wait", "t_dispatch", "t_enqueued", "dist", "ids",
         "compiles", "sp", "done", "seq", "t_pickup", "hedged",
-        "kernel_path",
+        "kernel_path", "admit_level", "page", "dispatch_info",
     )
 
     def __init__(self, batch: List[_Request]):
@@ -134,6 +135,9 @@ class _InFlight:
         self.done = threading.Event()
         self.hedged = False
         self.kernel_path = "unknown"
+        self.admit_level = 0
+        self.page = None           # explain: page-cache stats stamp
+        self.dispatch_info = None  # explain: ragged dispatch params stamp
 
 
 class MicroBatcher:
@@ -301,6 +305,13 @@ class MicroBatcher:
         self._kpath_default = "pallas" if _kernels.use_pallas() else "xla"
         self._last_kernel_path = self._kpath_default
         self._last_hedged = False
+        # explain stamps consumed per dispatch (written/read under
+        # _dispatch_lock, like _last_kernel_path) + the last admission
+        # verdict level (written by _admit on the same thread that then
+        # dispatches the batch)
+        self._last_page_stats = None
+        self._last_dispatch_info = None
+        self._last_admit_level = 0
 
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
@@ -435,6 +446,8 @@ class MicroBatcher:
         hedged = hedger is not None and any(r.priority == 0 for r in batch)
         self._last_hedged = hedged
         _kernels.consume_kernel_path()  # drop any stale stamp first
+        obs_explain.consume_page_stats()
+        obs_explain.consume_dispatch()
         if hedged:
             out = hedger.dispatch(*args)
         else:
@@ -442,6 +455,10 @@ class MicroBatcher:
         self._last_kernel_path = _kernels.consume_kernel_path(
             self._kpath_default
         )
+        # explain stamps ride the same thread-local side channel as the
+        # kernel-path stamp; empty (None) unless explain collection is on
+        self._last_page_stats = obs_explain.consume_page_stats()
+        self._last_dispatch_info = obs_explain.consume_dispatch()
         return out
 
     def _note_device_interval(self, t_start: float, t_end: float) -> None:
@@ -749,16 +766,35 @@ class MicroBatcher:
         if not batch:
             return batch
         ctrl = self.admission
+        index = self.metrics.name or "default"
         if ctrl is None:
-            return expire_deadlines(
-                batch, index=self.metrics.name or "default",
-                metrics=self.metrics,
+            alive = expire_deadlines(
+                batch, index=index, metrics=self.metrics,
             )
+            self._last_admit_level = 0
+            if len(alive) != len(batch) and obs_explain.enabled():
+                alive_ids = {id(r) for r in alive}
+                obs_explain.observe_admission(
+                    index,
+                    expired=[r for r in batch if id(r) not in alive_ids],
+                )
+            return alive
         decision = ctrl.decide(
             batch, queue_rows=self.queue_depth(), max_batch=self.max_batch,
         )
+        # recorded where the decision is already made (no re-derivation on
+        # the completion path); read by the same thread that dispatches
+        self._last_admit_level = decision.level
         if self.degraded is not None:
             self.degraded.step(decision.level > 0)
+        if (decision.shed or decision.expired) and obs_explain.enabled():
+            # shed / expired requests never reach a batch record — archive
+            # their minimal plans here (futures already carry the typed
+            # errors; this only observes)
+            obs_explain.observe_admission(
+                index, shed=decision.shed, expired=decision.expired,
+                level=decision.level,
+            )
         return list(decision.admitted)
 
     def _worker(self) -> None:
@@ -817,8 +853,15 @@ class MicroBatcher:
         stages_s: Dict[str, float],
         waits_s: Dict[str, float],
         error: Optional[str] = None,
+        kernel_path: str = "unknown",
+        hedged: bool = False,
+        admit_level: int = 0,
+        page: Optional[Dict[str, object]] = None,
+        dispatch_info: Optional[Dict[str, object]] = None,
     ) -> None:
-        """Feed one completed (or failed) batch to the flight recorder.
+        """Feed one completed (or failed) batch to the flight recorder
+        (and, when explain collection is on, to the query archive's tail
+        sampler — the same dict, one extra member scan).
 
         ``stages_s`` holds the post-pickup stage durations in execution
         order (the Chrome-trace builder lays them end to end from
@@ -829,7 +872,8 @@ class MicroBatcher:
         if not spans.enabled():
             return
         stages_ms = {k: v * 1e3 for k, v in {**waits_s, **stages_s}.items()}
-        flight.record_batch({
+        explain_on = obs_explain.enabled()
+        record = {
             "seq": seq,
             "index": self.metrics.name,
             "bucket": bucket,
@@ -840,6 +884,8 @@ class MicroBatcher:
             "t_done": t_done,
             "stages_s": stages_s,
             "waits_s": waits_s,
+            "kernel_path": kernel_path,
+            "hedged": hedged,
             "requests": [
                 {
                     "id": req.req_id,
@@ -856,11 +902,26 @@ class MicroBatcher:
                         {"k": req.k, "fid": req.fid}
                         if self.ragged is not None else {}
                     ),
+                    **(
+                        {"priority": req.priority} if explain_on else {}
+                    ),
                 }
                 for req in batch
             ],
             "error": error,
-        })
+        }
+        if explain_on:
+            # explain enrichment: decisions already made/stamped this
+            # dispatch — no clocks, no host syncs, one snapshot read
+            record["admission_level"] = admit_level
+            record["page"] = page
+            record["dispatch"] = dispatch_info
+            record["effort"] = (
+                self.effort.snapshot() if self.effort is not None else None
+            )
+        flight.record_batch(record)
+        if explain_on:
+            obs_explain.observe_batch(record)
 
     def _dispatch_locked(self, batch: List[_Request]) -> None:
         if not batch:
@@ -909,6 +970,7 @@ class MicroBatcher:
                 stages_s={"pad": t_pad},
                 waits_s={"queue": max(queue_waits, default=0.0)},
                 error=repr(exc),
+                admit_level=self._last_admit_level,
             )
             self.metrics.record_error(err_stage, len(batch))
             obs_events.publish(
@@ -965,6 +1027,11 @@ class MicroBatcher:
                 "copy_out": done - t2,
             },
             waits_s={"queue": max(queue_waits, default=0.0)},
+            kernel_path=self._last_kernel_path,
+            hedged=self._last_hedged,
+            admit_level=self._last_admit_level,
+            page=self._last_page_stats,
+            dispatch_info=self._last_dispatch_info,
         )
         if compiles and self._warm:
             # a recompile on the warmed hot path is a shape leak: capture
@@ -983,8 +1050,25 @@ class MicroBatcher:
                     "bucket": bucket,
                     "compiles": compiles,
                     "request_ids": [r.req_id for r in batch],
+                    **self._explain_summary(
+                        self._last_kernel_path, self._last_page_stats
+                    ),
                 },
             )
+
+    def _explain_summary(self, kernel_path: str,
+                         page: Optional[Dict[str, object]]):
+        """Slow-log enrichment: the explain summary (effort level and its
+        source, kernel path, page hit ratio) so slow lines are actionable
+        without an archive lookup.  Purely additive keys — the existing
+        entry fields stay byte-compatible."""
+        return obs_explain.summary_line({
+            "kernel_path": kernel_path,
+            "effort": (
+                self.effort.snapshot() if self.effort is not None else None
+            ),
+            "page": page,
+        })
 
     # -- pipelined dispatch (pipeline_depth > 1) -----------------------------
     @property
@@ -1096,6 +1180,12 @@ class MicroBatcher:
                 rec.dist, rec.ids = dist, ids
                 rec.hedged = self._last_hedged
                 rec.kernel_path = self._last_kernel_path
+                # explain stamps: instance state is only valid on this
+                # thread (dispatch lock held) — carry them on the record
+                # for the completion thread
+                rec.admit_level = self._last_admit_level
+                rec.page = self._last_page_stats
+                rec.dispatch_info = self._last_dispatch_info
             except Exception as exc:  # noqa: BLE001 — fail only this batch
                 spans.finish_span(rec.sp)
                 self._inflight_sem.release()
@@ -1170,6 +1260,11 @@ class MicroBatcher:
                     "inflight_wait": rec.inflight_wait,
                 },
                 error=repr(exc),
+                kernel_path=rec.kernel_path,
+                hedged=rec.hedged,
+                admit_level=rec.admit_level,
+                page=rec.page,
+                dispatch_info=rec.dispatch_info,
             )
             self.metrics.record_error("device", len(batch))
             obs_events.publish(
@@ -1252,6 +1347,11 @@ class MicroBatcher:
                 "queue": max(rec.queue_waits, default=0.0),
                 "inflight_wait": rec.inflight_wait,
             },
+            kernel_path=rec.kernel_path,
+            hedged=rec.hedged,
+            admit_level=rec.admit_level,
+            page=rec.page,
+            dispatch_info=rec.dispatch_info,
         )
         if rec.compiles and self._warm:
             # a recompile on the warmed hot path is a shape leak: capture
@@ -1271,6 +1371,7 @@ class MicroBatcher:
                     "bucket": rec.bucket,
                     "compiles": rec.compiles,
                     "request_ids": [r.req_id for r in batch],
+                    **self._explain_summary(rec.kernel_path, rec.page),
                 },
             )
 

@@ -129,10 +129,23 @@ class EffortArbiter:
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             autotune = self._autotune_level
+            pinned = self._pin
+        degraded = self.degraded.level if self.degraded is not None else 0
+        effective = self.effective_level()
+        # who set the effective level — the attribution per-query explain
+        # plans surface ("effort level and who set it")
+        if pinned is not None:
+            source = "pinned"
+        elif effective <= 0:
+            source = "full_effort"
+        elif degraded > autotune:
+            source = "overload_clamp"
+        else:
+            source = "autotune"
         return {
             "autotune_level": autotune,
-            "degraded_level": self.degraded.level
-            if self.degraded is not None else 0,
-            "effective_level": self.effective_level(),
+            "degraded_level": degraded,
+            "effective_level": effective,
             "max_level": self.max_level,
+            "source": source,
         }

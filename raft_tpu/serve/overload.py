@@ -149,6 +149,14 @@ def validate_priority(priority) -> int:
     return p
 
 
+def priority_name(priority) -> str:
+    """Human label for a priority class (explain plans, log lines)."""
+    try:
+        return PRIORITY_NAMES[int(priority)]
+    except (TypeError, ValueError, IndexError):
+        return "unknown"
+
+
 def expire_deadlines(batch: Sequence, *, now: Optional[float] = None,
                      index: str = "", metrics=None) -> List:
     """Return the still-alive requests of ``batch``, resolving expired

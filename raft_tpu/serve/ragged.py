@@ -61,8 +61,22 @@ from raft_tpu.core import env as _env
 from raft_tpu.core.bitset import Bitset, RowFilter
 from raft_tpu.core.trace import traced
 from raft_tpu.distance import DISTANCE_TYPES
+from raft_tpu.obs import explain as _explain
 from raft_tpu.ops.matrix import mask_row_k
 from raft_tpu.serve.mutation import MutableIndex
+
+
+def _params_info(search_params) -> Optional[dict]:
+    """Host-side summary of a SearchParams object for explain stamps —
+    only the effort-relevant Python values, never the object itself."""
+    if search_params is None:
+        return None
+    out = {}
+    for attr in ("n_probes", "itopk_size", "search_width", "lut_dtype"):
+        val = getattr(search_params, attr, None)
+        if val is not None:
+            out[attr] = str(val) if attr == "lut_dtype" else val
+    return out or None
 
 
 @dataclass(frozen=True)
@@ -235,6 +249,14 @@ class RaggedSearcher:
             # perf-ledger attribution: the SPMD body traces once, so the
             # routing stamp happens here on the host, not inside search
             _kernels.stamp_kernel_path("sharded")
+            if _explain.enabled():
+                # host-side decision stamp — the batcher consumes it on
+                # this same thread right after the call
+                _explain.stamp_dispatch({
+                    "k_max": self._spec.k_max,
+                    "sharded": True,
+                    "filters": sample_filter is not None,
+                })
             if sample_filter is not None:
                 dist, ids = index.search(
                     queries, self._spec.k_max, sample_filter=sample_filter
@@ -253,6 +275,14 @@ class RaggedSearcher:
             # reduced-effort params under pressure; every (bucket, level)
             # variant was warmed by the batcher's level-pinned warmup
             search_params = self._degraded.params_for(index)
+        if _explain.enabled():
+            # effective effort params actually handed to the backend —
+            # recorded where the decision is made, zero extra derivation
+            _explain.stamp_dispatch({
+                "k_max": self._spec.k_max,
+                "filters": sample_filter is not None,
+                "effort_params": _params_info(search_params),
+            })
         return index.search(
             queries, self._spec.k_max,
             sample_filter=sample_filter, row_k=row_k,

@@ -31,17 +31,22 @@ Typical lifecycle::
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Sequence, Union
+
+import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.core import env as _env
 from raft_tpu.core.trace import traced
 from raft_tpu.obs import autotune as obs_autotune
 from raft_tpu.obs import cost as obs_cost
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.obs import health as obs_health
 from raft_tpu.obs import incidents as obs_incidents
 from raft_tpu.obs import perf as obs_perf
 from raft_tpu.obs import slo as obs_slo
+from raft_tpu.obs import spans as obs_spans
 from raft_tpu.obs.quality import QualityAuditor
 from raft_tpu.serve.batcher import MicroBatcher
 from raft_tpu.serve.compactor import CompactionPolicy, Compactor
@@ -50,9 +55,11 @@ from raft_tpu.serve.metrics import ServingMetrics, install_compile_listener
 from raft_tpu.serve.mutation import MutableIndex
 from raft_tpu.serve.overload import (
     AdmissionController,
+    DeadlineExceeded,
     DegradedModeManager,
     HedgedDispatcher,
     OverloadConfig,
+    Shed,
 )
 from raft_tpu.serve.ragged import FilterRegistry, RaggedSearcher, RaggedSpec
 from raft_tpu.serve.registry import IndexRegistry
@@ -471,6 +478,9 @@ class SearchService:
             self.slo_engine.unwatch_index(name)
         if self.autotuner is not None:
             self.autotuner.unwatch_index(name)
+        # retire the index's archived plans + explain metric series (the
+        # same stale-series hygiene the SLO/autotune unwatch paths follow)
+        obs_explain.default_archive().unwatch_index(name)
 
     def names(self):
         return self.registry.names()
@@ -541,6 +551,175 @@ class SearchService:
             queries, timeout=timeout, k=k, fid=fid,
             priority=priority, deadline_s=deadline_s,
         )
+
+    @traced("serve.explain")
+    def explain(self, name: str, queries, *, k: Optional[int] = None,
+                fid: Optional[int] = None, priority: Optional[int] = None,
+                deadline_s: Optional[float] = None,
+                timeout: Optional[float] = None) -> obs_explain.ExplainPlan:
+        """EXPLAIN ANALYZE one real request; returns its
+        :class:`~raft_tpu.obs.explain.ExplainPlan`.
+
+        The request runs through the **normal** batched path — it
+        coalesces with live traffic and is answered by the same
+        executables, so the plan describes production behaviour, not a
+        simulation.  The plan joins the enriched flight-recorder batch
+        record (admission pressure, arbitrated effort level and its
+        source, capacity bucket, kernel path, page-cache interaction,
+        stage timeline) with a few deep-only host-side probes taken
+        *after* the dispatch completes: a coarse-probe replay for the
+        IVF kinds, per-shard contribution counts for a
+        :class:`~raft_tpu.serve.shard.ShardedIndex`, and the recall
+        auditor's verdict.  Works without ``RAFT_TPU_EXPLAIN`` — the
+        gate is forced open for this request only — but needs the
+        observability pipeline on.  A shed or deadline-expired request
+        still yields a plan (its admission section says why it never
+        reached the device).
+        """
+        if not obs_spans.enabled():
+            raise RuntimeError(
+                "SearchService.explain needs the observability pipeline "
+                "on (RAFT_TPU_OBS=1 or obs.enable())"
+            )
+        k, fid = self._ragged_args(name, k, fid)
+        batcher = self._batcher(name)
+        archive = obs_explain.default_archive()
+        outcome, error, result = "ok", None, None
+        with obs_explain.deep_scope():
+            fut = batcher.submit(
+                queries, k=k, fid=fid, priority=priority,
+                deadline_s=deadline_s,
+            )
+            req_id = fut.request_id
+            archive.watch(req_id)
+            try:
+                try:
+                    result = fut.result(timeout)
+                except Shed as exc:
+                    outcome, error = "shed", exc
+                except DeadlineExceeded as exc:
+                    outcome, error = "deadline_expired", exc
+                except Exception as exc:  # noqa: BLE001 — reported in plan
+                    outcome, error = "error", exc
+                # the archive entry lands on the completion thread right
+                # after the future resolves; poll briefly for it
+                entry = archive.find(req_id)
+                give_up = time.monotonic() + 2.0
+                while entry is None and time.monotonic() < give_up:
+                    time.sleep(0.001)
+                    entry = archive.find(req_id)
+            finally:
+                archive.unwatch(req_id)
+        if entry is None:
+            # record never landed (obs raced off mid-flight): degrade to
+            # a minimal plan — an operator entry point must not raise here
+            sections: Dict[str, object] = {
+                "request": {"id": req_id},
+                "outcome": {"outcome": outcome, "error": None,
+                            "sampled_reason": "deep"},
+                "available": False,
+            }
+        else:
+            sections = entry["plan"]
+        if outcome != "ok":
+            sections["outcome"] = {
+                **(sections.get("outcome") or {}),
+                "outcome": outcome,
+                "error": repr(error),
+            }
+        self._explain_deep_sections(name, queries, sections, result)
+        return obs_explain.ExplainPlan(sections)
+
+    def _explain_deep_sections(self, name, queries, sections, result):
+        """Append the deep-only plan sections: coarse-probe replay,
+        shard contributions, audit verdict, result payload.  Host-side
+        and off the hot path by construction — the dispatch already
+        completed, so the host pulls here stall nothing."""
+        try:
+            index, version = self.registry.get_versioned(name)
+        except KeyError:  # removed mid-explain
+            return
+        sections.setdefault("bucket", {})["version"] = version
+        if isinstance(index, MutableIndex) and index.kind in (
+            "ivf_flat", "ivf_pq"
+        ):
+            prev = sections.get("probe")
+            try:
+                info = self._probe_replay(name, index, queries)
+            except Exception as exc:  # noqa: BLE001 — section degrades
+                info = {"available": False, "error": repr(exc)}
+            if isinstance(prev, dict) and prev.get("params"):
+                info.setdefault("params", prev["params"])
+            sections["probe"] = info
+        from raft_tpu.serve.shard import ShardedIndex as _Sharded
+
+        if isinstance(index, _Sharded) and result is not None:
+            sections["shards"] = index.explain_contributions(
+                np.asarray(result[1])
+            )
+        auditor = self.auditor
+        if auditor is not None:
+            ewma = auditor.recall_ewma(name)
+            threshold = auditor.threshold
+            sections["audit"] = {
+                "recall_ewma": ewma,
+                "threshold": threshold,
+                "verdict": (
+                    "unaudited" if ewma is None
+                    else "ok" if ewma >= threshold else "below_threshold"
+                ),
+            }
+        else:
+            sections["audit"] = {"available": False}
+        if result is not None:
+            dists, ids = result
+            sections["results"] = {
+                "ids": np.asarray(ids).tolist(),
+                "distances": [
+                    round(float(v), 6)
+                    for v in np.asarray(dists, dtype=np.float64).reshape(-1)
+                ],
+            }
+
+    def _probe_replay(self, name, index, queries):
+        """Re-run the coarse pass host-side for one explained request:
+        same math the search executable re-derives in-trace
+        (deterministic — both agree), so the probed list ids and their
+        candidate counts can be reported without adding outputs to the
+        warmed executables (which would change shapes and recompile)."""
+        from raft_tpu.neighbors._common import coarse_select
+
+        base = index.index
+        params = None
+        with self._lock:
+            arb = self._effort.get(name)
+        if arb is not None:
+            # the same arbitrated effort params the dispatch read
+            params = arb.apply(index)
+        if params is None:
+            params = index.search_params
+        centers = base.centers
+        n_lists = int(centers.shape[0])
+        n_probes = int(getattr(params, "n_probes", 0) or 0)
+        n_probes = max(1, min(n_probes or n_lists, n_lists))
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        probes = np.asarray(
+            coarse_select(q, centers, index.metric, n_probes)
+        )
+        sizes = np.asarray(base.list_sizes)
+        probed = np.unique(probes.reshape(-1))
+        total = float(sizes.sum())
+        return {
+            "n_probes": n_probes,
+            "n_lists": n_lists,
+            "probed_lists": [int(p) for p in probed],
+            "candidates": int(sizes[probes.reshape(-1)].sum()),
+            "coverage": round(
+                float(sizes[probed].sum()) / total, 4
+            ) if total > 0 else None,
+        }
 
     @traced("serve.warmup")
     def warmup(self, name: Optional[str] = None) -> int:

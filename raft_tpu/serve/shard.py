@@ -593,6 +593,51 @@ class ShardedIndex:
         )
 
     # -- observability -------------------------------------------------------
+    def explain_contributions(self, ids) -> Dict[str, object]:
+        """Per-shard counts of merged result ids — which shards the
+        answer actually came from.  Deep-explain only: the ids are an
+        already-copied host result, so there is no extra sync and the
+        call never runs on the hot path.  Row-partitioned kinds own
+        contiguous id ranges (``id // rows_per_shard``); the IVF kinds
+        consult a lazily-built id→owner map from the partitioned
+        ``list_index``."""
+        try:
+            flat = np.asarray(ids).reshape(-1)
+            flat = flat[flat >= 0]
+            s_count = self.n_shards
+            if self.kind in ("brute_force", "cagra"):
+                r = int(self._parts["rows"].shape[1])
+                owner = flat // r
+            else:
+                owner_map = self._id_owner()
+                flat = flat[flat < owner_map.shape[0]]
+                owner = owner_map[flat]
+            counts = np.bincount(
+                owner[(owner >= 0) & (owner < s_count)], minlength=s_count
+            )
+            return {
+                "available": True,
+                "n_shards": s_count,
+                "per_shard": [int(c) for c in counts[:s_count]],
+            }
+        except Exception as exc:  # never let explain break serving
+            return {"available": False, "error": repr(exc)}
+
+    def _id_owner(self) -> np.ndarray:
+        """Cached global-id → owning-shard map for the IVF layouts
+        (built once, deep-explain only)."""
+        owner = getattr(self, "_owner_map", None)
+        if owner is None:
+            li = np.asarray(self._parts["list_index"])  # raft-tpu: ignore[HOSTSYNC] deep-explain only: one-time owner-map pull, never on the hot path
+            top = int(li.max()) + 1 if li.size else 0
+            owner = np.full(max(top, 0), -1, np.int32)
+            for s in range(li.shape[0]):
+                sid = li[s].reshape(-1)
+                sid = sid[sid >= 0]
+                owner[sid] = s
+            self._owner_map = owner
+        return owner
+
     def _publish_shard_gauges(self) -> None:
         """Per-shard row/list/byte gauges — the imbalance dashboard."""
         reg = obs.default_registry()

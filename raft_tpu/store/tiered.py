@@ -279,6 +279,15 @@ class TieredStore:
         with self._lock:
             return self.pool, self.page_slot
 
+    def counters(self) -> Tuple[int, int, int]:
+        """One consistent ``(hits, misses, resident)`` read — the
+        per-request page attribution brackets a dispatch's pager calls
+        with two of these and reports the deltas (explain plans)."""
+        with self._lock:
+            return (
+                self.hits, self.misses, int((self._resident >= 0).sum())
+            )
+
     def resident_pages(self) -> np.ndarray:
         """Resident page ids ordered by slot (serialization: replaying
         ``ensure_resident`` over this restores the placement)."""

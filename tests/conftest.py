@@ -74,10 +74,20 @@ def _reset_obs_globals(monkeypatch, tmp_path):
     events.reset()  # drops the default bus + incident manager + debounce
     flight.reset()
     health.reset_transitions()
+
+    # query archive + tail sampler (lazy: only if imported — the reset
+    # also re-reads the RAFT_TPU_EXPLAIN_* knobs a test may have set)
+    def _reset_explain():
+        explain_mod = sys.modules.get("raft_tpu.obs.explain")
+        if explain_mod is not None:
+            explain_mod.reset()
+
+    _reset_explain()
     yield
     events.reset()
     flight.reset()
     health.reset_transitions()
+    _reset_explain()
     spans.clear_recent()
     spans.set_ring_capacity()
     default_registry().clear_exemplars()

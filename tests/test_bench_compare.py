@@ -382,6 +382,47 @@ def test_flight_recorder_overhead_smoke_against_frozen_record(tmp_path):
 
 
 @pytest.mark.slow
+def test_explain_sampling_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the explain tail-sampling A/B: run ``bench.py
+    explain`` (always-on sampling under ``RAFT_TPU_EXPLAIN=1`` vs the
+    default off) and gate it with ``bench.py compare`` against the
+    frozen record.  The run must show sampling is effectively free on
+    the serve hot path: plans archived when on, zero when off, zero
+    post-warmup recompiles on both arms, and QPS within tolerance of
+    the sampling-off arm — the leg asserts the archive/recompile
+    invariants itself before emitting."""
+    candidate = str(tmp_path / "explain_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    env.pop("RAFT_TPU_EXPLAIN", None)  # the leg owns the gate
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "explain"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "explain leg recompiled on the hot path"
+    on, off = line["sampling_on"], line["sampling_off"]
+    assert on["archived_plans"] > 0
+    assert off["archived_plans"] == 0
+    # the acceptance bound is 2%; allow CI scheduling noise on top of it
+    assert line["qps_ratio"] >= 0.90, (
+        f"sampling overhead out of tolerance: {line['overhead_pct']}%"
+    )
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_explain_r19.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
 def test_compact_churn_smoke_against_frozen_record(tmp_path):
     """CI smoke for the online-compaction A/B: run ``bench.py compact``
     (compactor on vs off under identical churn) and gate it with

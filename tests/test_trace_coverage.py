@@ -49,12 +49,13 @@ def test_entry_point_discovery_is_not_vacuous(project):
 
 
 def test_serve_surface_discovery_is_not_vacuous(result):
-    # all twenty-three online entry points (service/mutation/ragged/
+    # all twenty-six online entry points (service/mutation/ragged/
     # compactor plus the SLO evaluator, incident ingest, the overload
     # trio, the perf-ledger pair, the sharded rebuild, the two
-    # module-level build entry points, and the page-store pager trio)
+    # module-level build entry points, the page-store pager trio, the
+    # deep-explain entry point, and the query-archive record/dump pair)
     # checked, against exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 25, result.stats
+    assert result.stats["traced_serve_entries_checked"] == 28, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
     assert result.stats["traced_labels"] >= 23, result.stats
 
