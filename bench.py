@@ -192,6 +192,9 @@ def main() -> None:
     if "shard" in sys.argv[1:]:
         run_shard_leg()
         return
+    if "shard_cagra" in sys.argv[1:]:
+        run_shard_cagra_leg()
+        return
     if "build" in sys.argv[1:]:
         run_build_leg()
         return
@@ -1430,6 +1433,137 @@ def run_shard_leg() -> None:
             "recompiles": sum(a["recompiles"] for a in results.values()),
             "n": n,
             "n_lists": n_lists,
+            "queries": n_q,
+        }
+    )
+
+
+def run_shard_cagra_leg() -> None:
+    """``python bench.py shard_cagra`` — partitioned-graph CAGRA A/B
+    (CPU, 8 forced host devices).
+
+    Three arms over the same CAGRA index and query batch at matched
+    ``itopk``:
+
+    - ``single``: the one-device CAGRA walk (the recall yardstick);
+    - ``graph``: GraphShardedIndex — cluster-cut subgraphs with halo
+      nodes, shard-local traversal, halo-frontier exchange every
+      ``sync_steps`` hops;
+    - ``brute``: ShardedIndex brute-refine — each shard scores every
+      resident row (exact; the control arm).
+
+    The headline value is the graph-arm QPS, the gate is recall: the
+    sharded walk must reach >= 0.95 of the single-host walk's recall
+    against exact ground truth.  The number this leg exists to freeze is
+    ``work_ratio_vs_brute`` — modeled per-query-per-shard distance
+    computations, brute over graph — the sublinear-device-work story.
+    Both sharded arms must show 0 post-warmup recompiles.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # bound the halo replicas so the frozen record's layout is stable
+    os.environ.setdefault("RAFT_TPU_SHARD_CAGRA_HALO", "512")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_tpu.comms.comms import local_comms
+    from raft_tpu.neighbors import brute_force, cagra
+    from raft_tpu.serve.metrics import compile_count, install_compile_listener
+    from raft_tpu.serve.shard import ShardedIndex
+    from raft_tpu.stats import recall_at_k
+
+    install_compile_listener()
+    n_dev = len(jax.devices())
+    n, d, k, n_q = 8192, 32, 10, 256
+    rng = np.random.default_rng(0)
+    dataset = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((n_q, d)).astype(np.float32)
+
+    index = cagra.build(
+        cagra.IndexParams(graph_degree=16, intermediate_graph_degree=32),
+        dataset,
+    )
+    # matched effort across all three arms: same beam, same hop budget
+    sp = cagra.SearchParams(itopk_size=32, max_iterations=16)
+
+    _, gt = brute_force.knn(jnp.asarray(dataset), jnp.asarray(queries), k)
+    gt = np.asarray(gt)
+
+    graph = ShardedIndex.from_index(
+        index, local_comms(n_dev), search_params=sp, cagra_mode="graph",
+        label="bench_cagra_graph",
+    )
+    brute = ShardedIndex.from_index(
+        index, local_comms(n_dev), search_params=sp, cagra_mode="brute",
+        label="bench_cagra_brute",
+    )
+
+    arms = {
+        "single": lambda q: cagra.search(sp, index, q, k),
+        "graph": lambda q: graph.search(q, k),
+        "brute": lambda q: brute.search(q, k),
+    }
+    results, ids_by_arm = {}, {}
+    for name, fn in arms.items():
+        t = timeit(fn, queries)  # timeit warms up first — compiles land
+        c1 = compile_count()     # before this read, recompiles after it
+        _, ids = fn(queries)
+        ids_by_arm[name] = np.asarray(ids)
+        results[name] = {
+            "qps": round(n_q / t, 1),
+            "latency_ms": round(t * 1e3, 2),
+            "recompiles": compile_count() - c1,
+            "recall": round(float(recall_at_k(ids_by_arm[name], gt)), 4),
+        }
+    assert results["graph"]["recompiles"] == 0, "graph arm recompiled hot"
+    assert results["brute"]["recompiles"] == 0, "brute arm recompiled hot"
+    recall_ratio = results["graph"]["recall"] / max(
+        results["single"]["recall"], 1e-9
+    )
+    assert recall_ratio >= 0.95, (
+        f"sharded graph walk lost recall vs single-host: "
+        f"{results['graph']['recall']} vs {results['single']['recall']}"
+    )
+
+    # modeled per-query-per-shard distance computations: the graph walk
+    # scores seeds + hops*width*deg rows; the brute arm scores every
+    # resident row.  This is the sublinear-device-work acceptance number.
+    work = graph.modeled_device_work(k)
+    brute_rows = int(brute._parts["rows"].shape[1])
+    results["graph"]["modeled_distances_per_query"] = work["distances"]
+    results["brute"]["modeled_distances_per_query"] = brute_rows
+    work_ratio = brute_rows / work["distances"]
+    assert work_ratio >= 1.5, (
+        f"graph walk is not sublinear vs brute-refine: "
+        f"{work['distances']} vs {brute_rows} distances/query/shard"
+    )
+
+    _emit(
+        {
+            "metric": f"shard_cagra_graph_qps_n{n // 1024}k_k{k}_s{n_dev}",
+            "value": results["graph"]["qps"],
+            "unit": "queries/s",
+            "platform": "cpu",
+            "devices": n_dev,
+            "arms": results,
+            "recall": results["graph"]["recall"],
+            "recall_ratio_vs_single": round(recall_ratio, 4),
+            "work_ratio_vs_brute": round(work_ratio, 2),
+            "modeled_work": work,
+            "halo_cap": int(os.environ["RAFT_TPU_SHARD_CAGRA_HALO"]),
+            "halo_rows": [int(h) for h in graph._shard_stats["halo"]],
+            "sync_steps": graph._sync_steps,
+            "itopk": sp.itopk_size,
+            "recompiles": sum(a["recompiles"] for a in results.values()),
+            "n": n,
             "queries": n_q,
         }
     )

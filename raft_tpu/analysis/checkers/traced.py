@@ -90,7 +90,7 @@ SERVE_FUNCTION_ENTRY_POINTS = {
 #: treat the stamp as an enum; a stray literal would silently mint a new
 #: ledger key that no dashboard or A/B gate knows to read
 KERNEL_PATH_VOCAB = frozenset(
-    {"pallas", "xla", "xla_filter_fallback", "sharded"}
+    {"pallas", "xla", "xla_filter_fallback", "sharded", "sharded_graph"}
 )
 
 

@@ -62,6 +62,17 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
     EnvVar("RAFT_TPU_SHARD_MERGE_DTYPE", "str", "float32",
            "bfloat16 quantizes the cross-shard merge all-gather of "
            "ShardedIndex candidate distances"),
+    EnvVar("RAFT_TPU_SHARD_CAGRA", "str", "brute",
+           "graph serves sharded CAGRA by partitioned graph traversal "
+           "with halo frontiers; brute keeps the row-partitioned "
+           "brute-refine control arm"),
+    EnvVar("RAFT_TPU_SHARD_CAGRA_HALO", "int", "unset",
+           "cap on replicated halo rows per shard of graph-mode sharded "
+           "CAGRA (0 = no halo; unset keeps every cross-cut neighbor)"),
+    EnvVar("RAFT_TPU_SHARD_CAGRA_SYNC_STEPS", "int", "4",
+           "local traversal hops between cross-shard frontier exchanges "
+           "in graph-mode sharded CAGRA (fixed cadence keeps the "
+           "collective count static and recompile-free)"),
     EnvVar("RAFT_TPU_RAGGED", "bool", "unset",
            "1 serves SearchService indexes in ragged mode: per-request k "
            "and filter id packed as descriptor data into one executable "

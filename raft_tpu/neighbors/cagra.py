@@ -739,6 +739,99 @@ def _search_jit(
     return vals.reshape(-1, k)[:q], idx.reshape(-1, k)[:q]
 
 
+# --------------------------------------------------------------------------
+# fixed-step traversal pieces (raft_tpu.serve.graph_shard: sharded graph
+# mode drives the hop loop itself, pausing every SYNC_STEPS hops for a
+# cross-shard frontier exchange)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("itopk", "metric"))
+def traverse_init(dataset, queries, seed_ids, itopk: int, metric: str):
+    """Candidate-buffer init from seed ids — the seed half of
+    ``_search_jit``, factored out for callers that own the hop loop.
+    Returns ``(buf_d, buf_i, explored)`` holding the buffer invariant
+    (``buf_i == -1`` wherever ``buf_d == +inf``; nothing explored)."""
+    vecs = _gather_rows(dataset, seed_ids)
+    dists = _query_distance(queries, vecs, metric)
+    dists = jnp.where(seed_ids < 0, jnp.inf, dists)
+    order, dup = sorted_id_dedup(seed_ids)
+    s_ids = jnp.take_along_axis(seed_ids, order, axis=1)
+    s_d = jnp.where(dup, jnp.inf, jnp.take_along_axis(dists, order, axis=1))
+    buf_d, buf_i = select_k(s_d, itopk, select_min=True, input_indices=s_ids)
+    buf_i = jnp.where(jnp.isfinite(buf_d), buf_i, -1)
+    explored = jnp.zeros(buf_d.shape, bool)
+    return buf_d, buf_i, explored
+
+
+@functools.partial(
+    jax.jit, static_argnames=("steps", "width", "metric", "fused")
+)
+def traverse_steps(dataset, graph, queries, buf_d, buf_i, explored,
+                   steps: int, width: int, metric: str, fused: bool = False):
+    """``steps`` unfiltered beam-search hops — ``_search_jit``'s loop body
+    as a standalone fixed-trip loop over ``(buf_d, buf_i, explored)``.
+
+    An exhausted frontier makes remaining hops no-ops (every parent slot
+    reads +inf, parents mask to −1, candidate scores stay +inf), so the
+    fixed trip count is always safe; that is what keeps the sharded graph
+    traversal's per-query collective count static and recompile-free.
+    ``graph`` may contain −1 entries (missing halo neighbors): both the
+    XLA body and the fused Pallas hop mask negative candidate ids.
+    ``fused`` must only be set when the caller verified
+    ``traverse_supported(dataset, itopk)`` — same gate as :func:`search`.
+    """
+    n = dataset.shape[0]
+    deg = graph.shape[1]
+    tile, itopk = buf_d.shape
+    c_w = width * deg
+    earlier = jnp.triu(jnp.ones((c_w, c_w), bool), k=1)
+
+    def body(_, state):
+        buf_i, buf_d, explored = state
+        front_d = jnp.where(explored | ~jnp.isfinite(buf_d), jnp.inf, buf_d)
+        _, ppos = select_k(front_d, width, select_min=True)
+        parent_ok = jnp.take_along_axis(front_d, ppos, axis=1) < jnp.inf
+        parents = jnp.take_along_axis(buf_i, ppos, axis=1)
+        explored = explored.at[jnp.arange(tile)[:, None], ppos].set(True)
+        if fused:
+            from raft_tpu.kernels import interpret_mode
+            from raft_tpu.kernels.cagra_traverse import cagra_fused_hop
+
+            parents_m = jnp.where(parent_ok, parents, -1)
+            buf_d, buf_i, explored = cagra_fused_hop(
+                dataset, graph, queries, parents_m, buf_d, buf_i, explored,
+                metric=metric, interpret=interpret_mode(),
+            )
+            return buf_i, buf_d, explored
+        nbrs = graph[jnp.clip(parents, 0, n - 1)]
+        nbrs = jnp.where(parent_ok[:, :, None], nbrs, -1)
+        cand = nbrs.reshape(tile, c_w)
+        vecs = _gather_rows(dataset, cand)
+        cd = _query_distance(queries, vecs, metric)
+        cd = jnp.where(cand < 0, jnp.inf, cd)
+        dup_in_batch = jnp.any(
+            (cand[:, :, None] == cand[:, None, :]) & earlier[None], axis=1
+        )
+        in_buf = jnp.any(cand[:, :, None] == buf_i[:, None, :], axis=2)
+        cd = jnp.where(dup_in_batch | in_buf, jnp.inf, cd)
+        all_i = jnp.concatenate([buf_i, cand], axis=1)
+        all_d = jnp.concatenate([buf_d, cd], axis=1)
+        all_e = jnp.concatenate(
+            [explored, jnp.zeros((tile, c_w), bool)], axis=1
+        )
+        buf_d, pos = select_k(all_d, itopk, select_min=True)
+        buf_i = jnp.take_along_axis(all_i, pos, axis=1)
+        buf_i = jnp.where(jnp.isfinite(buf_d), buf_i, -1)
+        explored = jnp.take_along_axis(all_e, pos, axis=1)
+        explored = explored | ~jnp.isfinite(buf_d)
+        return buf_i, buf_d, explored
+
+    buf_i, buf_d, explored = lax.fori_loop(
+        0, steps, body, (buf_i, buf_d, explored)
+    )
+    return buf_d, buf_i, explored
+
+
 @traced("cagra.search")
 def search(
     params: SearchParams,

@@ -87,6 +87,7 @@ from raft_tpu.serve.shard import (
     ShardedIndex,
     _pack_pass_words,
     _place,
+    _resolve_cagra_mode,
     merge_dtype_from_env,
 )
 
@@ -389,10 +390,14 @@ def _seed_subsample(key, data_np: np.ndarray, n: int, n_sub: int):
 # -- per-kind builders -------------------------------------------------------
 
 def _build_rows_sharded(comms, kind, data_np, x_sh, n, metric, merge_dtype,
-                        label, params, res):
+                        label, params, res, search_params=None,
+                        cagra_mode="env"):
     """brute_force / cagra: the serving layout IS the training layout —
     contiguous row blocks with global arange ids.  cagra additionally
-    builds its pruned search graph from the ring kNN graph."""
+    builds its pruned search graph from the ring kNN graph; with
+    ``cagra_mode="graph"`` the build emits the partitioned-graph serving
+    layout (:class:`~raft_tpu.serve.graph_shard.GraphShardedIndex`)
+    directly from that graph instead of the brute-refine row blocks."""
     s_count = comms.get_size()
     n_pad, d = data_np.shape
     r = n_pad // s_count
@@ -412,6 +417,36 @@ def _build_rows_sharded(comms, kind, data_np, x_sh, n, metric, merge_dtype,
             graph = np.asarray(
                 cagra.optimize(jnp.asarray(knn, jnp.int32), degree, res=res)
             )
+        if _resolve_cagra_mode(cagra_mode) == "graph":
+            from raft_tpu.serve.graph_shard import GraphShardedIndex
+
+            with _phase(label, "assemble"):
+                # partitioned-graph serving layout straight from the ring
+                # kNN graph: entry-point table + a transient single-host
+                # Index shell, cluster-cut and halo'd by _shard_graph
+                dataset = jnp.asarray(data_np[:n])
+                canonical = DISTANCE_TYPES[metric]
+                n_entries = params.entry_points
+                if n_entries is None:
+                    n_entries = cagra._auto_entry_points(n)
+                n_entries = min(n_entries, n)
+                entry_centers = entry_ids = None
+                if n_entries:
+                    entry_centers, entry_ids = cagra._build_entry_points(
+                        dataset, n_entries, canonical, params.seed, res
+                    )
+                tmp = cagra.Index(
+                    metric, dataset, jnp.asarray(graph, jnp.int32),
+                    entry_centers, entry_ids,
+                )
+                index = GraphShardedIndex._shard_graph(
+                    comms, tmp, None, search_params, merge_dtype, label
+                )
+                _rows_done(label, n)
+            # the pruned graph stays a build artifact for single-device
+            # consumers (cagra.from_graph), same as the brute layout below
+            index.cagra_graph = graph
+            return index
 
     with _phase(label, "assemble"):
         ids = np.full((s_count, r), -1, np.int32)
@@ -695,6 +730,7 @@ def build_sharded(
     merge_dtype="env",
     reduce_dtype: Optional[str] = None,
     label: str = "",
+    cagra_mode: str = "env",
     res: Optional[Resources] = None,
 ) -> ShardedIndex:
     """Build a :class:`ShardedIndex` of ``kind`` with the training data
@@ -713,6 +749,11 @@ def build_sharded(
     through ``IndexRegistry`` like any re-sharded index; ``Compactor``
     uses it as its distributed rebuild leg
     (:meth:`raft_tpu.serve.compactor.Compactor.rebuild_sharded`).
+
+    ``cagra_mode`` picks the CAGRA serving layout the build emits:
+    ``"brute"`` (row-partitioned brute refine — exact), ``"graph"``
+    (partitioned graph traversal with halo frontiers, built directly
+    from the ring kNN graph), or ``"env"`` (``RAFT_TPU_SHARD_CAGRA``).
     """
     if kind not in _BUILD_KINDS:
         raise ValueError(
@@ -733,7 +774,8 @@ def build_sharded(
     if kind in ("brute_force", "cagra"):
         index = _build_rows_sharded(
             comms, kind, data_np, x_sh, n, metric, merge_dtype, lbl,
-            index_params, res,
+            index_params, res, search_params=search_params,
+            cagra_mode=cagra_mode,
         )
     elif kind == "ivf_flat":
         index = _build_ivf_flat_sharded(

@@ -248,7 +248,14 @@ class RaggedSearcher:
             # per-(k × filter) lattice)
             # perf-ledger attribution: the SPMD body traces once, so the
             # routing stamp happens here on the host, not inside search
-            _kernels.stamp_kernel_path("sharded")
+            # (graph-mode CAGRA serves filtered traffic through its exact
+            # brute-refine core, so a filtered dispatch stamps "sharded")
+            graph_walk = (
+                getattr(index, "graph_mode", False) and sample_filter is None
+            )
+            _kernels.stamp_kernel_path(
+                "sharded_graph" if graph_walk else "sharded"
+            )
             if _explain.enabled():
                 # host-side decision stamp — the batcher consumes it on
                 # this same thread right after the call

@@ -654,9 +654,17 @@ class SearchService:
         from raft_tpu.serve.shard import ShardedIndex as _Sharded
 
         if isinstance(index, _Sharded) and result is not None:
-            sections["shards"] = index.explain_contributions(
-                np.asarray(result[1])
-            )
+            info = index.explain_contributions(np.asarray(result[1]))
+            if getattr(index, "graph_mode", False):
+                # graph-mode CAGRA: per-shard hop/halo accounting from an
+                # exchange-free traversal replay of this query batch
+                try:
+                    info["traversal"] = index.explain_traversal(queries)
+                except Exception as exc:  # noqa: BLE001 — section degrades
+                    info["traversal"] = {
+                        "available": False, "error": repr(exc)
+                    }
+            sections["shards"] = info
         auditor = self.auditor
         if auditor is not None:
             ewma = auditor.recall_ewma(name)

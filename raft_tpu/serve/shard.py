@@ -52,6 +52,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu import obs
+from raft_tpu import kernels as _kernels
 from raft_tpu.comms.comms import Comms, local_comms
 from raft_tpu.core import env as _env
 from raft_tpu.core.bitset import Bitset, RowFilter, WORD_BITS
@@ -96,6 +97,23 @@ def _pack_pass_words(mask: np.ndarray) -> np.ndarray:
     )
 
 
+#: env knob for how sharded CAGRA serves: "brute" (row-partitioned brute
+#: refine, exact) or "graph" (partitioned graph traversal, graph_shard.py)
+CAGRA_MODE_ENV = "RAFT_TPU_SHARD_CAGRA"
+
+
+def _resolve_cagra_mode(mode: str) -> str:
+    if mode == "env":
+        mode = (_env.env_str(CAGRA_MODE_ENV, "brute") or "brute")
+    mode = mode.strip().lower()
+    if mode not in ("brute", "graph"):
+        raise ValueError(
+            f"cagra shard mode {mode!r} not understood; expected 'brute', "
+            f"'graph' or 'env' ({CAGRA_MODE_ENV})"
+        )
+    return mode
+
+
 def _round_robin(n_items: int, n_shards: int) -> list:
     """Per-shard item indices, round-robin (balances size-sorted skew)."""
     return [np.arange(s, n_items, n_shards) for s in range(n_shards)]
@@ -110,6 +128,11 @@ class ShardedIndex:
     registered and hot-swapped through ``IndexRegistry``/``SearchService``
     and served by ``ReplicaGroup``/``MicroBatcher``.
     """
+
+    #: True on the partitioned-graph CAGRA subclass
+    #: (:class:`raft_tpu.serve.graph_shard.GraphShardedIndex`) — consumers
+    #: (kernel-path stamps, explain) read it duck-typed via ``getattr``
+    graph_mode = False
 
     def __init__(
         self,
@@ -157,6 +180,7 @@ class ShardedIndex:
         search_params=None,
         merge_dtype="env",
         label: str = "",
+        cagra_mode: str = "env",
     ) -> "ShardedIndex":
         """Partition a built index (or a compacted ``MutableIndex``) across
         ``comms``'s axis.
@@ -165,6 +189,12 @@ class ShardedIndex:
         knob; pass ``None`` (exact f32 merge) or ``jnp.bfloat16`` to
         override.  A ``MutableIndex`` may carry tombstones (folded into the
         sharded filter) but not live side-buffer rows.
+
+        ``cagra_mode`` selects how a CAGRA index is served: ``"brute"``
+        (default; row-partitioned brute refine — exact, the correctness
+        control arm), ``"graph"`` (partitioned graph traversal with halo
+        frontiers, :mod:`raft_tpu.serve.graph_shard`), or ``"env"`` to
+        consult ``RAFT_TPU_SHARD_CAGRA``.
         """
         comms = comms if comms is not None else local_comms(n_devices)
         if merge_dtype == "env":
@@ -193,10 +223,17 @@ class ShardedIndex:
             kind, inner = index.kind, index.index
         else:
             kind, inner = _infer_kind(index), index
+        if kind == "cagra" and _resolve_cagra_mode(cagra_mode) == "graph":
+            from raft_tpu.serve.graph_shard import GraphShardedIndex
+
+            return GraphShardedIndex._shard_graph(
+                comms, inner, deleted, search_params, merge_dtype, label
+            )
         if kind in ("brute_force", "cagra"):
             # CAGRA's graph is a per-shard traversal structure with global
-            # fan-out; the capacity win comes from sharding the rows, so the
-            # fallback is row-partitioned brute refine over its dataset
+            # fan-out; the default CAGRA mode therefore serves the capacity
+            # win by sharding the rows — row-partitioned brute refine over
+            # its dataset (exact; the graph mode's correctness control arm)
             return cls._shard_rows(
                 comms, kind, inner, deleted, merge_dtype, label
             )
@@ -349,6 +386,15 @@ class ShardedIndex:
                 # dispatch: tracing/enqueue of the sharded executable (the
                 # device wait lands in the caller's block_until_ready)
                 sp.add_stage("dispatch", dt)
+        # perf-ledger attribution (consumed by the batcher on this same
+        # thread): stamped AFTER the dispatch so a first-call trace of the
+        # per-shard core cannot overwrite it with its inner leg's stamp.
+        # Graph-mode CAGRA serves filtered traffic through its exact
+        # brute-refine core, hence the filter term.
+        graph_walk = self.graph_mode and filter_bits is None
+        _kernels.stamp_kernel_path(
+            "sharded_graph" if graph_walk else "sharded"
+        )
         obs.default_registry().histogram(
             "raft_tpu_sharded_search_seconds",
             help="host-side dispatch latency of index-sharded searches "
@@ -644,6 +690,7 @@ class ShardedIndex:
         per_bytes = self.per_shard_bytes()
         rows = self._shard_stats.get("rows")
         lists = self._shard_stats.get("lists")
+        halo = self._shard_stats.get("halo")
         for s in range(self.n_shards):
             labels = {"index": self.label, "shard": str(s)}
             if rows is not None:
@@ -656,6 +703,13 @@ class ShardedIndex:
                     "raft_tpu_shard_lists",
                     help="IVF lists owned by each index shard",
                 ).set(float(lists[s]), **labels)
+            if halo is not None:
+                reg.gauge(
+                    "raft_tpu_shard_halo_rows",
+                    help="replicated halo rows held by each graph-mode "
+                    "CAGRA shard (cross-cut neighbors kept so local hops "
+                    "never dead-end at the partition boundary)",
+                ).set(float(halo[s]), **labels)
             reg.gauge(
                 "raft_tpu_shard_live_bytes",
                 help="per-device bytes held by each index shard "

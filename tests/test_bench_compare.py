@@ -341,6 +341,52 @@ def test_shard_index_smoke_against_frozen_record(tmp_path):
 
 
 @pytest.mark.slow
+def test_shard_cagra_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the partitioned-graph CAGRA A/B: run
+    ``bench.py shard_cagra`` (single-host vs graph-sharded vs
+    brute-refine over 8 forced host devices) and gate it with
+    ``bench.py compare`` against the frozen record.  The run must show
+    the sharded walk holding >= 0.95 of the single-host recall at
+    matched itopk, modeled per-shard device work measurably below the
+    brute arm's, and zero hot-path recompiles in every arm."""
+    candidate = str(tmp_path / "shard_cagra_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "shard_cagra"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["devices"] == 8
+    assert line["recall_ratio_vs_single"] >= 0.95, (
+        "graph-sharded walk lost recall vs the single-host walk"
+    )
+    assert line["work_ratio_vs_brute"] >= 1.5, (
+        "graph walk's modeled per-shard work is not sublinear vs brute"
+    )
+    assert line["recompiles"] == 0, "shard_cagra leg recompiled hot"
+    arms = line["arms"]
+    assert arms["brute"]["recall"] >= 0.999  # the exact control arm
+    assert arms["graph"]["modeled_distances_per_query"] < (
+        arms["brute"]["modeled_distances_per_query"]
+    )
+
+    baseline = os.path.join(
+        REPO, "benchmarks", "BENCH_shard_cagra_r20.json"
+    )
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
 def test_flight_recorder_overhead_smoke_against_frozen_record(tmp_path):
     """CI smoke for the flight-recorder A/B: run ``bench.py flight``
     (recorder on vs ``obs.set_enabled(False)``) and gate it with
