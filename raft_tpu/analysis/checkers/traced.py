@@ -76,6 +76,7 @@ SERVE_ENTRY_POINTS = {
     ("store.tiered.TieredStore", "evict"): "store.pager.evict",
     ("obs.explain.QueryArchive", "record"): "explain.record",
     ("obs.explain.QueryArchive", "dump"): "explain.dump",
+    ("obs.gateway.OperationalGateway", "dispatch"): "gateway.request",
 }
 
 #: module-level (function) serve entry points and their span labels —

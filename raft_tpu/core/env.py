@@ -222,6 +222,20 @@ KNOWN_VARS: Tuple[EnvVar, ...] = (
            "serialized FrontierModel (bench frontier sweep output) the "
            "autotuner navigates; unset falls back to the synthetic "
            "effort-ladder model"),
+    EnvVar("RAFT_TPU_GATEWAY", "bool", "unset",
+           "1 gives every SearchService an operational HTTP gateway "
+           "(scrape/probe/debug endpoints; SearchService(gateway=...) "
+           "overrides)"),
+    EnvVar("RAFT_TPU_GATEWAY_PORT", "int", "0",
+           "gateway listen port (0 binds an ephemeral port, read back "
+           "from OperationalGateway.port)"),
+    EnvVar("RAFT_TPU_GATEWAY_TOKEN", "str", "unset",
+           "bearer token the gateway's POST /admin plane requires; "
+           "admin-on without a token refuses every admin request"),
+    EnvVar("RAFT_TPU_GATEWAY_ADMIN", "bool", "unset",
+           "1 enables the gateway's POST /admin plane (compact, "
+           "effort_pin, flight_dump, archive_dump); off, those routes "
+           "404"),
     EnvVar("RAFT_TPU_DISABLE_PROFILER", "bool", "unset",
            "1 disables the Perfetto capture helper"),
     EnvVar("RAFT_TPU_PERF_LEDGER", "bool", "1",

@@ -58,6 +58,12 @@ into queryable state:
   instruments, plus an always-on tail-sampled :class:`QueryArchive`
   that retains full plans for the interesting tail and dumps alongside
   flight records into the correlated incident timeline.
+- :mod:`~raft_tpu.obs.gateway` — stdlib-only operational HTTP server
+  over this whole pull surface: ``/metrics`` (content-negotiated
+  Prometheus/OpenMetrics), ``/healthz``/``/readyz`` load-balancer
+  probes, snapshot/incident/flight/explain/autotune debug endpoints and
+  a token-guarded admin plane; owned by ``SearchService(gateway=True)``
+  or run standalone via ``python -m raft_tpu.obs.gateway``.
 
 Quick start::
 
@@ -80,6 +86,9 @@ from raft_tpu.obs.cost import (
     refresh_live_buffer_gauges,
 )
 from raft_tpu.obs.export import (
+    OPENMETRICS_CONTENT_TYPE,
+    PROMETHEUS_CONTENT_TYPE,
+    negotiate_content_type,
     snapshot_json,
     to_openmetrics,
     to_prometheus,
@@ -145,6 +154,7 @@ from raft_tpu.obs import (
     events,
     explain,
     flight,
+    gateway,
     health,
     incidents,
     perf,
@@ -155,6 +165,7 @@ from raft_tpu.obs import (
     spans,
     xla_events,
 )
+from raft_tpu.obs.gateway import GatewayConfig, OperationalGateway
 
 registry = default_registry  # `obs.registry()` reads as the obvious accessor
 
@@ -192,11 +203,15 @@ __all__ = [
     "FrontierModel",
     "FrontierPoint",
     "Gauge",
+    "GatewayConfig",
     "Histogram",
     "Incident",
     "IncidentManager",
     "LabelCardinalityError",
     "MetricsRegistry",
+    "OPENMETRICS_CONTENT_TYPE",
+    "OperationalGateway",
+    "PROMETHEUS_CONTENT_TYPE",
     "PerfLedger",
     "QualityAuditor",
     "QueryArchive",
@@ -221,12 +236,14 @@ __all__ = [
     "explain_snapshot",
     "finish_span",
     "flight",
+    "gateway",
     "health",
     "incidents",
     "incidents_snapshot",
     "install",
     "last_capture",
     "ledger_snapshot",
+    "negotiate_content_type",
     "next_request_id",
     "open_span",
     "perf",

@@ -25,6 +25,50 @@ from raft_tpu.obs.registry import (
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_OK = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
 
+#: the canonical scrape content types — every HTTP surface (the
+#: operational gateway, user-wired handlers, docs) must cite these two
+#: constants rather than re-inlining the literals
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+_OPENMETRICS_MEDIA = "application/openmetrics-text"
+_CLASSIC_MEDIA = ("text/plain", "text/*", "*/*", "")
+
+
+def negotiate_content_type(accept: Optional[str]) -> str:
+    """Pick the exposition format an ``Accept`` header asks for.
+
+    Returns :data:`OPENMETRICS_CONTENT_TYPE` when the client lists
+    ``application/openmetrics-text`` with a quality at least as high as
+    any classic-text alternative (Prometheus's scraper sends exactly
+    that when OpenMetrics ingestion is on), else
+    :data:`PROMETHEUS_CONTENT_TYPE`.  Malformed q-values are treated as
+    1.0 — a scrape endpoint should degrade to *an* answer, never to 400.
+    """
+    if not accept:
+        return PROMETHEUS_CONTENT_TYPE
+    q_open, q_classic = 0.0, 0.0
+    for part in accept.split(","):
+        params = part.split(";")
+        media = params[0].strip().lower()
+        q = 1.0
+        for p in params[1:]:
+            k, _, v = p.partition("=")
+            if k.strip().lower() == "q":
+                try:
+                    q = float(v.strip())
+                except ValueError:
+                    q = 1.0
+        if media == _OPENMETRICS_MEDIA:
+            q_open = max(q_open, q)
+        elif media in _CLASSIC_MEDIA:
+            q_classic = max(q_classic, q)
+    if q_open > 0.0 and q_open >= q_classic:
+        return OPENMETRICS_CONTENT_TYPE
+    return PROMETHEUS_CONTENT_TYPE
+
 
 def _sanitize(name: str, label: bool = False) -> str:
     out = re.sub(r"[^a-zA-Z0-9_:]" if not label else r"[^a-zA-Z0-9_]",

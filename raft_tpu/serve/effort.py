@@ -77,6 +77,19 @@ class EffortArbiter:
             with self._lock:
                 self._pin = prev
 
+    def set_pin(self, level: Optional[int]) -> Optional[int]:
+        """Operator pin: force the effective level until explicitly
+        cleared with ``None`` — the persistent sibling of the scoped
+        :meth:`pinned` (the gateway's ``POST /admin/effort_pin`` uses
+        it).  Clamped to the warmed ladder so a pin can never dispatch
+        an uncompiled variant; returns the stored pin."""
+        with self._lock:
+            if level is None:
+                self._pin = None
+            else:
+                self._pin = max(0, min(int(level), self.max_level))
+            return self._pin
+
     # -- the single writer ---------------------------------------------
 
     @property

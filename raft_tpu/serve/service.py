@@ -42,6 +42,7 @@ from raft_tpu.core.trace import traced
 from raft_tpu.obs import autotune as obs_autotune
 from raft_tpu.obs import cost as obs_cost
 from raft_tpu.obs import explain as obs_explain
+from raft_tpu.obs import gateway as obs_gateway
 from raft_tpu.obs import health as obs_health
 from raft_tpu.obs import incidents as obs_incidents
 from raft_tpu.obs import perf as obs_perf
@@ -103,6 +104,10 @@ class SearchService:
         ragged: Union[None, bool, RaggedSpec] = None,
         overload: Union[None, bool, OverloadConfig] = None,
         autotune: Union[None, bool, obs_autotune.Autotuner] = None,
+        gateway: Union[
+            None, bool, obs_gateway.GatewayConfig,
+            obs_gateway.OperationalGateway,
+        ] = None,
     ):
         install_compile_listener()
         # full pipeline: XLA event attribution + span/slowlog snapshot
@@ -215,6 +220,27 @@ class SearchService:
         obs_incidents.default_manager().add_context_source(
             "service", self._incident_context
         )
+        # gateway=None: RAFT_TPU_GATEWAY decides.  True: bind config from
+        # env.  A GatewayConfig binds a fresh server; a prebuilt
+        # OperationalGateway is adopted as-is (and pointed at this
+        # service if it has none).  The gateway only calls the pull APIs
+        # above — owning it here is lifecycle, not coupling.
+        self.gateway: Optional[obs_gateway.OperationalGateway] = None
+        if isinstance(gateway, obs_gateway.OperationalGateway):
+            self.gateway = gateway
+            if self.gateway.service is None:
+                self.gateway.service = self
+        elif isinstance(gateway, obs_gateway.GatewayConfig):
+            self.gateway = obs_gateway.OperationalGateway(
+                self, config=gateway
+            )
+        else:
+            if gateway is None:
+                gateway = _env.env_bool("RAFT_TPU_GATEWAY", False)
+            if gateway:
+                self.gateway = obs_gateway.OperationalGateway(self)
+        if self.gateway is not None and start:
+            self.gateway.start()
 
     # -- index management ----------------------------------------------------
     def add_index(
@@ -1024,6 +1050,11 @@ class SearchService:
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:
+        # gateway first: stop answering external probes and admin verbs
+        # before the subsystems they read start going down (a scrape
+        # mid-teardown would race half-stopped state)
+        if self.gateway is not None:
+            self.gateway.close()
         # autotuner before the SLO engine: its ticks read slo health
         if self.autotuner is not None:
             self.autotuner.stop()

@@ -888,3 +888,45 @@ def test_autotune_smoke_against_frozen_record(tmp_path):
     )
     assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
     assert "PASS" in cmp_out.stdout, cmp_out.stdout
+
+
+@pytest.mark.slow
+def test_gateway_smoke_against_frozen_record(tmp_path):
+    """CI smoke for the gateway scrape-under-load A/B: run ``bench.py
+    gateway`` (a 1 Hz /metrics + /healthz poller against a paced
+    serving stream vs the identical stream unpolled) and gate it with
+    ``bench.py compare`` against the frozen record.  The leg
+    self-asserts scrape liveness and zero recompiles; here we re-pin
+    the load-bearing facts: the poller actually exercised the gateway,
+    every scrape completed (transport-level), neither arm recompiled,
+    and being scraped cost QPS within tolerance of the unpolled arm."""
+    candidate = str(tmp_path / "gateway_candidate.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAFT_TPU_BENCH_RECORD=candidate,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "gateway"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["recompiles"] == 0, "gateway scraping recompiled serve"
+    polled, unpolled = line["polled"], line["unpolled"]
+    assert polled["scrapes"] >= 2, "poller never completed a scrape cycle"
+    assert polled["scrape_errors"] == 0, "scrape transport failures"
+    assert sum(polled["scrape_codes"].values()) >= 2 * polled["scrapes"]
+    assert unpolled["scrapes"] == 0 and not unpolled["scrape_codes"]
+    # the acceptance bar is "within noise"; allow CI scheduling slack
+    assert line["qps_ratio"] >= 0.90, (
+        f"scrape overhead out of tolerance: {line['overhead_pct']}%"
+    )
+
+    baseline = os.path.join(REPO, "benchmarks", "BENCH_gateway_r21.json")
+    cmp_out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "compare",
+         "--baseline", baseline, "--candidate", candidate],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cmp_out.returncode == 0, cmp_out.stdout + cmp_out.stderr
+    assert "PASS" in cmp_out.stdout, cmp_out.stdout

@@ -49,13 +49,13 @@ def test_entry_point_discovery_is_not_vacuous(project):
 
 
 def test_serve_surface_discovery_is_not_vacuous(result):
-    # all twenty-six online entry points (service/mutation/ragged/
-    # compactor plus the SLO evaluator, incident ingest, the overload
-    # trio, the perf-ledger pair, the sharded rebuild, the two
-    # module-level build entry points, the page-store pager trio, the
-    # deep-explain entry point, and the query-archive record/dump pair)
-    # checked, against exactly one MicroBatcher
-    assert result.stats["traced_serve_entries_checked"] == 28, result.stats
+    # all online entry points (service/mutation/ragged/compactor plus
+    # the SLO evaluator, incident ingest, the overload trio, the
+    # perf-ledger pair, the sharded rebuild, the two module-level build
+    # entry points, the page-store pager trio, the deep-explain entry
+    # point, the query-archive record/dump pair, and the gateway's
+    # request dispatch) checked, against exactly one MicroBatcher
+    assert result.stats["traced_serve_entries_checked"] == 29, result.stats
     assert result.stats["traced_batcher_classes"] == 1, result.stats
     assert result.stats["traced_labels"] >= 23, result.stats
 
